@@ -1,0 +1,220 @@
+"""GF(2^w) arithmetic core — tables, scalar ops, and bit-plane linear maps.
+
+This is the L1 layer of the TPU-native Reed-Solomon framework (capability
+parity with the reference's device GF layer, ``matrix.cu:24-220``, its host
+twin ``cpu-decode.c:24-100``, the legacy multi-width library
+``galoisfield.cu`` (w in {4, 8, 16}), and the branchless table scheme the
+reference's R&D series converged on, ``cpu-rs-log-exp-3.c:51-98``).
+
+Design notes (TPU-first, NOT a translation):
+
+* The canonical table layout is the fully-branchless one: ``log[0]`` holds a
+  large sentinel (``2*(order)``, where ``order = 2^w - 1``) and the exp table
+  is extended and zero-padded so that ``exp[log[a] + log[b]]`` is correct for
+  ALL byte pairs including zeros — no zero-operand branch anywhere.  The
+  reference arrived at exactly this scheme for its GPU constant tables
+  (1021-entry exp, ``gflog[0] = 510`` for w=8).
+
+* The *production* multiply path on TPU does not use these tables at all:
+  GF(2^w) multiplication by a constant ``a`` is a GF(2)-linear map on the bit
+  vector of ``b``, so a whole RS encode is one (w*p, w*k) x (w*k, m) binary
+  matrix product — XOR-accumulation becomes integer matmul + parity, which is
+  native MXU work.  :func:`bitmatrix` / :func:`expand_bitmatrix` build those
+  operators; ``ops/gemm.py`` consumes them.
+
+* Everything here is NumPy (host-side): tables are built once per field width
+  and are tiny.  The JAX/Pallas kernels import the *constants* produced here.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+# Primitive polynomials, one per supported field width (same fields the
+# reference's legacy library supported, galoisfield.cu:22-25).
+# w=8 is 0x11D = octal 0435 = x^8+x^4+x^3+x^2+1, the poly baked into the
+# reference's in-kernel table generator (matrix.cu:47-75).
+PRIMITIVE_POLY = {
+    4: 0x13,  # x^4 + x + 1
+    8: 0x11D,  # x^8 + x^4 + x^3 + x^2 + 1
+    16: 0x1100B,  # x^16 + x^12 + x^3 + x + 1
+}
+
+
+def _carryless_mul_mod(a: int, b: int, w: int, poly: int) -> int:
+    """Bitwise shift-add GF multiply (the no-table oracle; the reference's
+    ``cpu-rs-loop.c:51-64`` used the same strategy as its table-free variant).
+    Used only to validate the tables in tests."""
+    r = 0
+    while b:
+        if b & 1:
+            r ^= a
+        b >>= 1
+        a <<= 1
+        if a >> w:
+            a ^= poly
+    return r
+
+
+class GaloisField:
+    """Tables and vectorised host-side ops for GF(2^w), w in {4, 8, 16}.
+
+    Attributes (all NumPy arrays, suitable for shipping to device constants):
+
+    ``log``
+        ``(2^w,) int32``, ``log[0] = 2*order`` sentinel (branchless scheme).
+    ``exp``
+        ``(4*order + 1,)`` of the element dtype: ``exp[i] = g^(i mod order)``
+        for ``i < 2*order``, zero for ``i >= 2*order``.  Any index touching a
+        zero operand's sentinel lands in the zero pad, so
+        ``exp[log[a] + log[b]]`` needs no branch.  (w=8: 1021 entries,
+        matching the reference's ``gfexp_cMem[1021]`` / ``gflog[0]=510``.)
+    ``mul_table``
+        Full multiplication table ``(2^w, 2^w)`` — only materialised for
+        w <= 8 (the w=8 64 KB table mirrors the reference's
+        ``cpu-rs-full.c`` strategy; for w=16 it would be 8 GB).
+    """
+
+    def __init__(self, w: int = 8):
+        if w not in PRIMITIVE_POLY:
+            raise ValueError(f"unsupported field width {w}; choose from {sorted(PRIMITIVE_POLY)}")
+        self.w = w
+        self.poly = PRIMITIVE_POLY[w]
+        self.size = 1 << w  # field cardinality 2^w
+        self.order = self.size - 1  # multiplicative group order
+        self.dtype = np.uint8 if w <= 8 else np.uint16
+
+        sentinel = 2 * self.order
+        log = np.zeros(self.size, dtype=np.int32)
+        exp_core = np.zeros(self.order, dtype=np.int64)
+        x = 1
+        for i in range(self.order):
+            exp_core[i] = x
+            log[x] = i
+            x <<= 1
+            if x & self.size:
+                x ^= self.poly
+        log[0] = sentinel
+
+        # exp indices seen in practice: mul -> log[a]+log[b] in [0, 2*sentinel];
+        # div -> log[a] + order - log[b] in [0, sentinel + order].  Pad to
+        # 2*sentinel + 1 and zero everything >= sentinel so sentinel-tainted
+        # indices read 0.
+        exp = np.zeros(2 * sentinel + 1, dtype=self.dtype)
+        idx = np.arange(sentinel) % self.order
+        exp[:sentinel] = exp_core[idx].astype(self.dtype)
+        self.log = log
+        self.exp = exp
+        self.sentinel = sentinel
+
+        if w <= 8:
+            a = np.arange(self.size, dtype=np.int64)
+            self.mul_table = self.exp[self.log[a][:, None] + self.log[a][None, :]]
+        else:
+            self.mul_table = None
+
+        # Per-bit multiply operators: bitmat_by_value[v] is the (w, w) GF(2)
+        # matrix M_v with bits(v * b) = M_v @ bits(b) mod 2.  Column j of M_v
+        # is the bit vector of v * (1 << j).  Built lazily for w=16.
+        self._bitmats: np.ndarray | None = None
+
+    # ----- scalar / vectorised field ops -------------------------------------
+
+    def mul(self, a, b):
+        """Elementwise GF multiply of arrays/scalars (branchless log/exp)."""
+        a = np.asarray(a, dtype=np.int64)
+        b = np.asarray(b, dtype=np.int64)
+        return self.exp[self.log[a] + self.log[b]]
+
+    def div(self, a, b):
+        """Elementwise GF divide; division by zero raises."""
+        a = np.asarray(a, dtype=np.int64)
+        b = np.asarray(b, dtype=np.int64)
+        if np.any(b == 0):
+            raise ZeroDivisionError("GF division by zero")
+        return self.exp[self.log[a] + self.order - self.log[b]]
+
+    def pow(self, a, e):
+        """GF power a**e (e a non-negative integer array/scalar).
+
+        Matches the reference's Vandermonde generator contract
+        (``matrix.cu:204-208``): 0**0 == 1, 0**e == 0 for e > 0.
+        """
+        a = np.asarray(a, dtype=np.int64)
+        e = np.asarray(e, dtype=np.int64)
+        la = self.log[a]
+        # exp index for nonzero a; zero a handled by sentinel only when e > 0.
+        idx = (la * e) % self.order
+        out = self.exp[idx]
+        zero_base = (a == 0) & (e > 0)
+        out = np.where(zero_base, 0, out)
+        return out.astype(self.dtype) if out.ndim else self.dtype(out)
+
+    def inv(self, a):
+        """Multiplicative inverse; inverse of zero raises."""
+        a = np.asarray(a, dtype=np.int64)
+        if np.any(a == 0):
+            raise ZeroDivisionError("GF inverse of zero")
+        return self.exp[self.order - self.log[a]]
+
+    def matmul(self, A, B):
+        """GF matrix product (XOR-accumulated).  Host oracle for the TPU GEMM
+        (role of the reference's naive CPU ``matrix_mul``, cpu-rs.c:182-198).
+        """
+        A = np.asarray(A, dtype=np.int64)
+        B = np.asarray(B, dtype=np.int64)
+        if A.ndim != 2 or B.ndim != 2 or A.shape[1] != B.shape[0]:
+            raise ValueError(f"shape mismatch {A.shape} @ {B.shape}")
+        out = np.zeros((A.shape[0], B.shape[1]), dtype=self.dtype)
+        for t in range(A.shape[1]):
+            out ^= self.mul(A[:, t][:, None], B[t][None, :])
+        return out
+
+    # ----- GF(2) bit-plane view (the TPU-native representation) --------------
+
+    def bitmatrix(self, v: int) -> np.ndarray:
+        """(w, w) uint8 GF(2) matrix of multiply-by-v: bits(v*b) = M @ bits(b).
+
+        ``M[i, j] = bit i of (v * 2^j)``; bit 0 is the LSB.
+        """
+        cols = self.mul(int(v), 1 << np.arange(self.w, dtype=np.int64))
+        shifts = np.arange(self.w, dtype=np.int64)
+        return ((cols[None, :].astype(np.int64) >> shifts[:, None]) & 1).astype(np.uint8)
+
+    @property
+    def bitmats(self) -> np.ndarray:
+        """(2^w, w, w) uint8 — bitmatrix(v) for every field element."""
+        if self._bitmats is None:
+            v = np.arange(self.size, dtype=np.int64)
+            prods = self.mul(v[:, None], 1 << np.arange(self.w, dtype=np.int64)[None, :])
+            shifts = np.arange(self.w, dtype=np.int64)
+            self._bitmats = (
+                (prods[:, None, :].astype(np.int64) >> shifts[None, :, None]) & 1
+            ).astype(np.uint8)
+        return self._bitmats
+
+    def expand_bitmatrix(self, A: np.ndarray) -> np.ndarray:
+        """Expand a (p, k) GF coefficient matrix to its (p*w, k*w) GF(2)
+        operator.  Block (pi, ki) is ``bitmatrix(A[pi, ki])``.
+
+        This is what turns an RS encode/decode into ONE binary matmul:
+        ``bits(C) = expand_bitmatrix(A) @ bits(B) mod 2``.
+        """
+        A = np.asarray(A)
+        p, k = A.shape
+        blocks = self.bitmats[A.astype(np.int64)]  # (p, k, w, w)
+        return blocks.transpose(0, 2, 1, 3).reshape(p * self.w, k * self.w)
+
+
+@functools.lru_cache(maxsize=None)
+def get_field(w: int = 8) -> GaloisField:
+    """Singleton per-width field instance."""
+    return GaloisField(w)
+
+
+# The default field everything operates in (the reference's master branch is
+# GF(256); its `extend` branch and legacy library cover w=4/16 — supported
+# here via get_field(4) / get_field(16)).
+GF8 = get_field(8)

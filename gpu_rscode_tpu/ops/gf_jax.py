@@ -1,0 +1,62 @@
+"""JAX-side GF(2^w) primitives: table constants and elementwise ops.
+
+These are the on-device counterparts of :mod:`.gf` (role of the reference's
+``__device__ __const__`` table copies, ``matrix.cu:34-39``).  On TPU the
+tables live in whatever memory XLA chooses (they are tiny; XLA keeps them
+resident), and the elementwise ops lower to vector gathers on the VPU.
+
+The table-gather path is the *fallback* multiply strategy; the production
+GEMM uses the bit-plane MXU formulation in :mod:`.gemm`.  Both are kept —
+the reference's own GF(16)-vs-GF(256) experiment showed the strategy choice
+is worth benchmarking, not assuming (design.tex:469-512).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+import numpy as np
+
+from .gf import get_field
+
+
+@functools.lru_cache(maxsize=None)
+def _np_tables(w: int = 8):
+    gf = get_field(w)
+    return np.asarray(gf.log, dtype=np.int32), gf.exp.astype(np.int32)
+
+
+def tables(w: int = 8):
+    """(log, exp) as int32 device constants for field width ``w``.
+
+    The cache holds NumPy; conversion happens per call so tables embed as
+    XLA constants whether called inside or outside a trace (caching device
+    arrays created mid-trace would leak tracers).
+    """
+    log, exp = _np_tables(w)
+    return jnp.asarray(log), jnp.asarray(exp)
+
+
+def mul_table(w: int = 8):
+    """Full (2^w, 2^w) multiply table (w <= 8 only) as a device constant —
+    the one-gather strategy (reference's ``cpu-rs-full.c`` 64K-table study)."""
+    gf = get_field(w)
+    if gf.mul_table is None:
+        raise ValueError(f"full mul table not materialised for w={w}")
+    return jnp.asarray(gf.mul_table)
+
+
+def gf_mul(a, b, w: int = 8):
+    """Elementwise GF multiply of int arrays (branchless log/exp gathers)."""
+    log, exp = tables(w)
+    return exp[log[a] + log[b]]
+
+
+def gf_inv(a, w: int = 8):
+    """Elementwise multiplicative inverse.  Branchless: zero deterministically
+    maps to 0 (its sentinel log lands the index in the zero pad) — callers
+    that need division-by-zero to be an *error* must check beforehand."""
+    gf = get_field(w)
+    log, exp = tables(w)
+    return exp[(gf.order - log[a]) % (2 * gf.sentinel + 1)]

@@ -1,0 +1,110 @@
+"""GF matrix inversion — host Gauss-Jordan (production) and a fully
+on-device jitted variant.
+
+Capability parity: the reference inverts the k x k decode submatrix on the
+host CPU (``cpu-decode.c:251-298``, called from ``decode.cu:333``); a GPU
+inverter exists but is dormant (``matrix.cu:667-744``) and a blocked GPU
+variant was prototyped (``decode-gj.cu:1059-1201``).  The TPU build keeps the
+same split — k is tiny (<= a few hundred), so the host inverts in
+microseconds — but also ships :func:`invert_matrix_jax`, a single-dispatch
+``lax.fori_loop`` Gauss-Jordan that runs entirely on device (what C7/C11
+wanted to be: no host<->device ping-pong per pivot row).
+
+Pivoting is done by ROW exchange, which is correct as-is for the inverse
+accumulator.  The reference pivots by COLUMN exchange and has a copy-pasted
+bug in all three of its implementations (the accumulator's column swap writes
+to the wrong column, ``matrix.cu:449-453`` / ``cpu-decode.c:131-135`` /
+``cpu-rs.c:229-233``), silently corrupting the inverse whenever a zero
+diagonal pivot forces a swap.  Row pivoting avoids the permutation
+book-keeping entirely; ``tests/test_matrix.py::test_invert_zero_pivot_regression``
+carries the zero-pivot regression the reference would fail.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .gf import GaloisField, get_field
+from .gf_jax import gf_inv, tables
+
+
+class SingularMatrixError(ValueError):
+    """Raised when the decode submatrix is not invertible (the reference
+    aborts with "Matrix not invertible!!", cpu-rs-loop.c:83-85)."""
+
+
+def invert_matrix(M: np.ndarray, gf: GaloisField | None = None) -> np.ndarray:
+    """Invert a square GF matrix by Gauss-Jordan elimination with row
+    pivoting.  Host-side NumPy; this is the production decode-path inverter.
+    """
+    gf = gf or get_field(8)
+    M = np.array(M, dtype=np.int64)
+    if M.ndim != 2 or M.shape[0] != M.shape[1]:
+        raise ValueError(f"expected square matrix, got {M.shape}")
+    k = M.shape[0]
+    R = np.eye(k, dtype=np.int64)
+    for i in range(k):
+        nz = np.nonzero(M[i:, i])[0]
+        if nz.size == 0:
+            raise SingularMatrixError(f"matrix not invertible (column {i} has no pivot)")
+        r = i + int(nz[0])
+        if r != i:
+            M[[i, r]] = M[[r, i]]
+            R[[i, r]] = R[[r, i]]
+        inv_p = int(gf.inv(M[i, i]))
+        M[i] = gf.mul(M[i], inv_p)
+        R[i] = gf.mul(R[i], inv_p)
+        mask = M[:, i] != 0
+        mask[i] = False
+        if mask.any():
+            factors = M[mask, i][:, None]
+            M[mask] ^= gf.mul(factors, M[i][None, :]).astype(np.int64)
+            R[mask] ^= gf.mul(factors, R[i][None, :]).astype(np.int64)
+    return R.astype(gf.dtype)
+
+
+def _invert_jax(M: jnp.ndarray, w: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    log, exp = tables(w)
+    k = M.shape[0]
+
+    def gmul(a, b):
+        return exp[log[a] + log[b]]
+
+    A = jnp.concatenate([M.astype(jnp.int32), jnp.eye(k, dtype=jnp.int32)], axis=1)
+    rows = jnp.arange(k)
+
+    def body(i, carry):
+        A, ok = carry
+        col = A[:, i]
+        cand = (col != 0) & (rows >= i)
+        ok = ok & jnp.any(cand)
+        r = jnp.argmax(cand)
+        perm = rows.at[i].set(r).at[r].set(i)
+        A = A[perm]
+        pivot = A[i, i]
+        inv_p = gf_inv(pivot, w)
+        row_i = gmul(A[i], inv_p)
+        A = A.at[i].set(row_i)
+        elim = gmul(A[:, i][:, None], row_i[None, :])
+        elim = jnp.where((rows == i)[:, None], 0, elim)
+        return A ^ elim, ok
+
+    A, ok = jax.lax.fori_loop(0, k, body, (A, jnp.bool_(True)))
+    return A[:, k:], ok
+
+
+_invert_jax_jit = jax.jit(_invert_jax, static_argnums=1)
+
+
+def invert_matrix_jax(M, w: int = 8):
+    """Fully on-device Gauss-Jordan inverse.
+
+    Returns ``(inverse int32 (k, k), ok bool)``; ``ok`` is False for singular
+    input (in which case the inverse contents are garbage).  One compiled
+    dispatch for the whole elimination — the design the reference's dormant
+    GPU inverter was reaching for without its per-pivot host round-trips
+    (``matrix.cu:678-743``).
+    """
+    return _invert_jax_jit(jnp.asarray(M), w)

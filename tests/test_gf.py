@@ -1,0 +1,115 @@
+"""GF(2^w) core tests: tables vs bitwise oracle, field axioms, bit-plane maps.
+
+The reference has no unit tests; these cover what its R&D series
+(cpu-rs-*.c strategy variants) established by manual benchmarking, plus the
+branchless-table contract (gflog[0] sentinel + zero-padded exp) exhaustively.
+"""
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu.ops.gf import GaloisField, get_field, _carryless_mul_mod, PRIMITIVE_POLY
+
+
+@pytest.fixture(scope="module", params=[4, 8])
+def gf(request):
+    return get_field(request.param)
+
+
+def test_table_layout_matches_reference_scheme():
+    gf = get_field(8)
+    # The branchless scheme the reference bakes into its GPU constants:
+    # 1021-entry exp, log[0] = 510 (cpu-rs-log-exp-3.c:51-98, matrix.cu:34-37).
+    assert gf.exp.shape[0] == 1021
+    assert gf.log[0] == 510
+    assert np.all(gf.exp[510:] == 0)
+    assert gf.exp[0] == 1 and gf.exp[255] == 1  # g^0 == g^255 == 1
+
+
+def test_mul_exhaustive_vs_bitwise(gf):
+    a = np.arange(gf.size)
+    A, B = np.meshgrid(a, a, indexing="ij")
+    got = gf.mul(A, B)
+    want = np.array(
+        [[_carryless_mul_mod(int(x), int(y), gf.w, gf.poly) for y in a] for x in a]
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_mul_zero_branchless(gf):
+    a = np.arange(gf.size)
+    assert np.all(gf.mul(a, 0) == 0)
+    assert np.all(gf.mul(0, a) == 0)
+
+
+def test_div_inverse_roundtrip(gf):
+    a = np.arange(1, gf.size)
+    b = np.arange(1, gf.size)
+    A, B = np.meshgrid(a, b, indexing="ij")
+    q = gf.div(A, B)
+    np.testing.assert_array_equal(gf.mul(q, B), A)
+    np.testing.assert_array_equal(gf.mul(a, gf.inv(a)), np.ones_like(a))
+    assert np.all(gf.div(0, b) == 0)
+    with pytest.raises(ZeroDivisionError):
+        gf.div(1, 0)
+    with pytest.raises(ZeroDivisionError):
+        gf.inv(0)
+
+
+def test_pow(gf):
+    # matches repeated multiplication; 0^0 == 1, 0^e == 0 (matrix.cu:204-208)
+    for base in [0, 1, 2, 5, gf.size - 1]:
+        acc = 1
+        for e in range(20):
+            assert int(gf.pow(base, e)) == acc
+            acc = int(gf.mul(acc, base))
+    assert int(gf.pow(0, 0)) == 1
+    assert int(gf.pow(0, 3)) == 0
+
+
+def test_full_mul_table(gf):
+    if gf.mul_table is None:
+        pytest.skip("no full table for this width")
+    a = np.arange(gf.size)
+    A, B = np.meshgrid(a, a, indexing="ij")
+    np.testing.assert_array_equal(gf.mul_table[A, B], gf.mul(A, B))
+
+
+def test_gf16_field_smoke():
+    gf = get_field(16)
+    assert gf.mul_table is None
+    a = np.array([1, 2, 0x1234, 0xFFFF])
+    np.testing.assert_array_equal(gf.mul(a, gf.inv(np.where(a == 0, 1, a))) != 0, a != 0)
+    assert int(gf.mul(0x8000, 2)) == _carryless_mul_mod(0x8000, 2, 16, PRIMITIVE_POLY[16])
+
+
+def test_bitmatrix_is_multiplication(gf):
+    rng = np.random.default_rng(0)
+    for v in rng.integers(0, gf.size, size=16):
+        M = gf.bitmatrix(int(v))
+        for b in rng.integers(0, gf.size, size=16):
+            bits_b = (int(b) >> np.arange(gf.w)) & 1
+            bits_c = (M.astype(np.int64) @ bits_b) % 2
+            c = int((bits_c << np.arange(gf.w)).sum())
+            assert c == int(gf.mul(int(v), int(b)))
+
+
+def test_expand_bitmatrix_matmul(gf):
+    rng = np.random.default_rng(1)
+    p, k, m = 3, 5, 17
+    A = rng.integers(0, gf.size, size=(p, k))
+    B = rng.integers(0, gf.size, size=(k, m))
+    want = gf.matmul(A, B)
+    Ab = gf.expand_bitmatrix(A)  # (p*w, k*w)
+    Bbits = ((B[:, None, :].astype(np.int64) >> np.arange(gf.w)[None, :, None]) & 1).reshape(
+        k * gf.w, m
+    )
+    Cbits = (Ab.astype(np.int64) @ Bbits) % 2
+    C = (Cbits.reshape(p, gf.w, m) << np.arange(gf.w)[None, :, None]).sum(axis=1)
+    np.testing.assert_array_equal(C.astype(gf.dtype), want)
+
+
+def test_matmul_identity(gf):
+    rng = np.random.default_rng(2)
+    B = rng.integers(0, gf.size, size=(6, 11))
+    np.testing.assert_array_equal(gf.matmul(np.eye(6, dtype=np.int64), B), B)

@@ -1,0 +1,119 @@
+"""Generator matrices and GF Gauss-Jordan inversion tests, including the
+zero-pivot regression the reference's column-swap bug would fail."""
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu.models.vandermonde import (
+    cauchy_matrix,
+    total_matrix,
+    vandermonde_matrix,
+)
+from gpu_rscode_tpu.ops.gf import get_field
+from gpu_rscode_tpu.ops.inverse import (
+    SingularMatrixError,
+    invert_matrix,
+    invert_matrix_jax,
+)
+
+GF = get_field(8)
+
+
+def test_vandermonde_matches_reference_formula():
+    # EM[i][j] = gf_pow((j+1) % 256, i)  (matrix.cu:752-759)
+    V = vandermonde_matrix(4, 6)
+    for i in range(4):
+        for j in range(6):
+            assert int(V[i, j]) == int(GF.pow((j + 1) % 256, i))
+    assert np.all(V[0] == 1)
+    np.testing.assert_array_equal(V[1], np.arange(1, 7))
+
+
+def test_total_matrix_layout():
+    T = total_matrix(2, 4)
+    assert T.shape == (6, 4)
+    np.testing.assert_array_equal(T[:4], np.eye(4, dtype=np.uint8))
+    np.testing.assert_array_equal(T[4:], vandermonde_matrix(2, 4))
+
+
+@pytest.mark.parametrize("k", [1, 2, 4, 10, 32])
+def test_invert_random(k):
+    rng = np.random.default_rng(k)
+    # random invertible matrices: retry until nonsingular
+    for _ in range(5):
+        M = rng.integers(0, 256, size=(k, k))
+        try:
+            inv = invert_matrix(M)
+        except SingularMatrixError:
+            continue
+        np.testing.assert_array_equal(GF.matmul(M, inv), np.eye(k, dtype=np.uint8))
+        np.testing.assert_array_equal(GF.matmul(inv, M), np.eye(k, dtype=np.uint8))
+
+
+def test_invert_zero_pivot_regression():
+    """A matrix with M[0,0] == 0 that IS invertible.
+
+    This drives the pivot-exchange path, where all three copies of the
+    reference's inverter corrupt the accumulator (matrix.cu:449-453,
+    cpu-decode.c:131-135, cpu-rs.c:229-233 write the swap to the wrong
+    column).  Our row-pivoting implementation must get it right.
+    """
+    M = np.array([[0, 1, 2], [1, 2, 3], [4, 5, 6]], dtype=np.uint8)
+    inv = invert_matrix(M)
+    np.testing.assert_array_equal(GF.matmul(M, inv), np.eye(3, dtype=np.uint8))
+
+
+def test_invert_singular_raises():
+    M = np.array([[1, 2], [1, 2]], dtype=np.uint8)
+    with pytest.raises(SingularMatrixError):
+        invert_matrix(M)
+    with pytest.raises(SingularMatrixError):
+        invert_matrix(np.zeros((3, 3), dtype=np.uint8))
+
+
+def test_decode_submatrix_inversion():
+    """The actual decode scenario: drop the first n-k chunks (the adversarial
+    pattern of unit-test.sh:3-24) and invert the surviving submatrix."""
+    k, p = 4, 2
+    T = total_matrix(p, k)
+    surv = T[p : p + k]  # rows 2..5: two natives + both parities
+    inv = invert_matrix(surv)
+    np.testing.assert_array_equal(GF.matmul(surv, inv), np.eye(k, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("k", [2, 4, 10])
+def test_invert_jax_matches_host(k):
+    rng = np.random.default_rng(100 + k)
+    M = rng.integers(0, 256, size=(k, k))
+    try:
+        want = invert_matrix(M)
+    except SingularMatrixError:
+        pytest.skip("random draw singular")
+    got, ok = invert_matrix_jax(M)
+    assert bool(ok)
+    np.testing.assert_array_equal(np.asarray(got, dtype=np.uint8), want)
+
+
+def test_invert_jax_zero_pivot():
+    M = np.array([[0, 1, 2], [1, 2, 3], [4, 5, 6]], dtype=np.uint8)
+    got, ok = invert_matrix_jax(M)
+    assert bool(ok)
+    np.testing.assert_array_equal(
+        GF.matmul(np.asarray(got), M), np.eye(3, dtype=np.uint8)
+    )
+
+
+def test_invert_jax_singular_flag():
+    _, ok = invert_matrix_jax(np.array([[1, 2], [1, 2]], dtype=np.uint8))
+    assert not bool(ok)
+
+
+def test_cauchy_all_submatrices_invertible():
+    k, p = 4, 3
+    T = np.concatenate([np.eye(k, dtype=np.uint8), cauchy_matrix(p, k)], axis=0)
+    import itertools
+
+    for rows in itertools.combinations(range(k + p), k):
+        sub = T[list(rows)]
+        inv = invert_matrix(sub)  # must never raise
+        np.testing.assert_array_equal(GF.matmul(sub, inv), np.eye(k, dtype=np.uint8))
