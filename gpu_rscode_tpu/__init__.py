@@ -1,0 +1,28 @@
+"""gpu_rscode_tpu — TPU-native Reed-Solomon erasure coding framework.
+
+A ground-up JAX/XLA/Pallas re-design with the capabilities of the CUDA
+reference ``zvonkok/GPU-RSCode`` (see SURVEY.md at the repo root for the
+component-by-component parity map).
+
+Public surface:
+
+- :class:`gpu_rscode_tpu.codec.RSCodec` — stripe-level (n, k) codec.
+- :func:`gpu_rscode_tpu.api.encode_file` / :func:`~gpu_rscode_tpu.api.decode_file`
+  — file-level streaming encode/decode, reference-compatible formats.
+- :mod:`gpu_rscode_tpu.cli` — the ``rs`` command (``python -m gpu_rscode_tpu``).
+- :mod:`gpu_rscode_tpu.ops` — GF(2^w) tables, GF-GEMM (XLA + Pallas), inversion.
+- :mod:`gpu_rscode_tpu.parallel` — mesh sharding + streaming pipelines.
+"""
+
+__all__ = ["RSCodec"]
+__version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Lazy: importing the package must not pull in jax (backend init is slow
+    # and `rs -h` has to be instant).
+    if name == "RSCodec":
+        from .codec import RSCodec
+
+        return RSCodec
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

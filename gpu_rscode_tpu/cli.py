@@ -1,0 +1,160 @@
+"""``rs`` command-line driver — flag-compatible with the reference CLI.
+
+Reference surface (main.c:32-164): encode ``-k <k> -n <n> -e <file>``;
+decode ``-d -i <file> -c <conf> [-o <out>]``; tuning ``-p`` (device grid
+cap -> here: GEMM column-tile hint) and ``-s`` (stream count -> here:
+pipeline depth, number of segments in flight); ``-h`` help; upper- and
+lower-case flags both accepted.  ``-i/-c/-o`` are rejected unless a decode
+was selected first, matching the reference's ordering rule.
+
+Extensions (flagged long options, no reference equivalent):
+``--generator {vandermonde,cauchy}``, ``--strategy {bitplane,table}``,
+``--quiet`` (suppress the timing report), ``--profile-dir DIR``
+(jax.profiler trace output).
+"""
+
+from __future__ import annotations
+
+import getopt
+import os
+import sys
+
+from .utils.timing import PhaseTimer
+
+_USAGE = """Usage:
+[-h]: show usage information
+Encode: [-k|-K nativeBlockNum] [-n|-N totalBlockNum] [-e|-E fileName]
+Decode: [-d|-D] [-i|-I originalFileName] [-c|-C config] [-o|-O output]
+For encoding, the -k, -n, and -e options are all necessary.
+For decoding, the -d, -i, and -c options are all necessary.
+If -o is not set, the original file name is used as the output file name.
+Performance-tuning options:
+[-p|-P]: column-tile size hint for the GF-GEMM kernel
+[-s|-S]: pipeline depth (segments in flight, default 2)
+Extensions: [--generator vandermonde|cauchy] [--strategy bitplane|table]
+            [--segment-bytes N] [--quiet] [--profile-dir DIR]
+"""
+
+
+def _fail(msg: str) -> "int":
+    print(msg, file=sys.stderr)
+    print(_USAGE, file=sys.stderr)
+    return 2
+
+
+def main(argv: list[str] | None = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    try:
+        opts, extra = getopt.getopt(
+            argv,
+            "S:s:P:p:K:k:N:n:E:e:I:i:C:c:O:o:DdHh",
+            ["generator=", "strategy=", "segment-bytes=", "quiet", "profile-dir="],
+        )
+    except getopt.GetoptError as e:
+        return _fail(f"rs: {e}")
+    if extra:
+        return _fail(f"rs: unexpected arguments {extra}")
+
+    native_num = total_num = 0
+    pipeline_depth = 2
+    tile_hint = 0
+    in_file = conf_file = out_file = None
+    op = None
+    generator, strategy = "vandermonde", "bitplane"
+    segment_bytes = None
+    quiet = False
+    profile_dir = None
+
+    for flag, val in opts:
+        f = flag.lower()
+        if f in ("-s",):
+            pipeline_depth = int(val)
+        elif f in ("-p",):
+            tile_hint = int(val)
+        elif f in ("-k",):
+            native_num = int(val)
+        elif f in ("-n",):
+            total_num = int(val)
+        elif f in ("-e",):
+            in_file, op = val, "encode"
+        elif f in ("-d",):
+            op = "decode"
+        elif f in ("-i", "-c", "-o"):
+            if op != "decode":
+                return _fail(f"rs: {flag} is only valid after -d (decode)")
+            if f == "-i":
+                in_file = val
+            elif f == "-c":
+                conf_file = val
+            else:
+                out_file = val
+        elif f == "-h":  # getopt folds -H here via f.lower()
+            print(_USAGE)
+            return 0
+        elif f == "--generator":
+            generator = val
+        elif f == "--strategy":
+            strategy = val
+        elif f == "--segment-bytes":
+            segment_bytes = int(val)
+        elif f == "--quiet":
+            quiet = True
+        elif f == "--profile-dir":
+            profile_dir = val
+
+    if op is None:
+        return _fail("rs: choose encode (-e) or decode (-d)")
+
+    # Import lazily: jax init is slow and -h must be instant.
+    from . import api
+
+    kwargs = dict(strategy=strategy, pipeline_depth=max(1, pipeline_depth))
+    if segment_bytes:
+        kwargs["segment_bytes"] = segment_bytes
+    elif tile_hint:
+        # -p caps the per-dispatch column extent, the closest analog of the
+        # reference's gridDim.x cap (encode.cu:348-355).
+        kwargs["segment_bytes"] = max(1, tile_hint) * 128 * 1024
+
+    timer = PhaseTimer(enabled=True)
+    ctx = None
+    if profile_dir:
+        import jax
+
+        ctx = jax.profiler.trace(profile_dir)
+        ctx.__enter__()
+    try:
+        if op == "encode":
+            if native_num <= 0 or total_num <= 0 or not in_file:
+                return _fail("rs: encoding requires -k, -n and -e")
+            if total_num <= native_num:
+                return _fail(f"rs: need n > k (got n={total_num}, k={native_num})")
+            api.encode_file(
+                in_file,
+                native_num,
+                total_num - native_num,
+                generator=generator,
+                timer=timer,
+                **kwargs,
+            )
+            nbytes = os.path.getsize(in_file)
+        else:
+            if not in_file or not conf_file:
+                return _fail("rs: decoding requires -i and -c")
+            out = api.decode_file(in_file, conf_file, out_file, timer=timer, **kwargs)
+            nbytes = os.path.getsize(out)
+    except (ValueError, FileNotFoundError, OSError) as e:
+        print(f"rs: error: {e}", file=sys.stderr)
+        return 1
+    finally:
+        if ctx is not None:
+            ctx.__exit__(None, None, None)
+
+    if not quiet:
+        print(f"== {op} {in_file} ==")
+        print(timer.summary(data_bytes=nbytes))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,99 @@
+"""RSCodec — the stripe-level coding engine (compute only, no file IO).
+
+This is the L3 equivalent of the reference's host-callable coding math
+(``gen_encoding_matrix`` / ``encode_chunk`` / ``decode_chunk`` /
+``CPU_invert_matrix``, matrix.h:63-102 + cpu-decode.h:27), re-packaged the
+JAX way: a stateless object holding the (tiny) generator matrix as host
+NumPy, whose encode/decode methods dispatch one jitted GF-GEMM over a
+(rows, chunk_bytes) stripe.  The k x k decode inversion runs on host (same
+host/device split the reference production path uses — decode.cu:333) but an
+on-device inverter is available (:func:`..ops.inverse.invert_matrix_jax`).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .models.vandermonde import generator_matrix
+from .ops.gemm import Strategy, gf_matmul_jit
+from .ops.gf import get_field
+from .ops.inverse import invert_matrix
+
+
+class RSCodec:
+    """(n, k) Reed-Solomon codec over GF(2^w).
+
+    ``native_num`` = k data chunks, ``parity_num`` = n - k parity chunks.
+    ``generator``: "vandermonde" (reference-compatible: the exact matrix the
+    reference generates and stores in .METADATA) or "cauchy" (any-k-subset
+    decodable).  ``strategy``: GEMM strategy ("bitplane" MXU / "table" VPU).
+    """
+
+    def __init__(
+        self,
+        native_num: int,
+        parity_num: int,
+        w: int = 8,
+        generator: str = "vandermonde",
+        strategy: Strategy = "bitplane",
+    ):
+        if native_num < 1 or parity_num < 0:
+            raise ValueError(f"bad (k={native_num}, p={parity_num})")
+        self.gf = get_field(w)
+        self.w = w
+        self.native_num = native_num
+        self.parity_num = parity_num
+        self.strategy: Strategy = strategy
+        self.generator = generator
+        gen = generator_matrix(generator, parity_num, native_num, self.gf)
+        eye = np.eye(native_num, dtype=self.gf.dtype)
+        self.total_matrix = np.concatenate([eye, gen], axis=0)  # (n, k)
+
+    @property
+    def n(self) -> int:
+        return self.native_num + self.parity_num
+
+    @property
+    def parity_block(self) -> np.ndarray:
+        return self.total_matrix[self.native_num :]
+
+    # ----- stripe ops (device) ----------------------------------------------
+
+    def encode(self, data):
+        """(k, m) natives -> (p, m) parity.  Systematic: natives pass through
+        unchanged, only parity is computed (the reference's encode kernel has
+        the same shape: (n-k) x k coefficient block, matrix.cu:767-776)."""
+        return gf_matmul_jit(self.parity_block, data, w=self.w, strategy=self.strategy)
+
+    def decode(self, decode_mat, chunks):
+        """(k, k) recovery matrix x (k, m) surviving chunks -> (k, m) natives."""
+        return gf_matmul_jit(decode_mat, chunks, w=self.w, strategy=self.strategy)
+
+    # ----- decode-matrix construction (host) --------------------------------
+
+    def decode_matrix(self, survivor_rows) -> np.ndarray:
+        """Inverse of the k x k submatrix of the total matrix selected by the
+        k ``survivor_rows`` (chunk indices of the survivors, in the order
+        their chunks will be stacked).  Raises SingularMatrixError if the
+        survivor set is not decodable."""
+        rows = list(survivor_rows)
+        if len(rows) != self.native_num:
+            raise ValueError(
+                f"need exactly k={self.native_num} survivors, got {len(rows)}"
+            )
+        if any(r < 0 or r >= self.n for r in rows):
+            raise ValueError(f"survivor index out of range in {rows}")
+        sub = self.total_matrix[rows]
+        return invert_matrix(sub, self.gf)
+
+    def decode_matrix_from(self, total_mat: np.ndarray, survivor_rows) -> np.ndarray:
+        """Same, but against an externally supplied total matrix (the one
+        parsed from .METADATA — the authoritative copy for decode, matching
+        the reference which trusts the file over regeneration)."""
+        rows = list(survivor_rows)
+        total_mat = np.asarray(total_mat)
+        if any(r < 0 or r >= total_mat.shape[0] for r in rows):
+            raise ValueError(
+                f"survivor chunk index out of range for n={total_mat.shape[0]}: {rows}"
+            )
+        return invert_matrix(total_mat[rows], self.gf)

@@ -1,0 +1,64 @@
+"""Conf-file generator — the erasure-scenario / fault-injection tool.
+
+Capability parity with the reference's ``src/unit-test.sh`` (its only test
+automation): given n, k and a file name, write ``conf-<n>-<k>-<file>``
+listing the LAST k chunk names — i.e. the adversarial scenario where the
+first n-k chunks (including natives) are lost, forcing a real matrix
+inversion on decode.  A ``--pattern`` option generalises it into a proper
+fault-injection tool: choose exactly which chunks survive.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from ..utils.fileformat import chunk_file_name, write_conf
+
+
+def make_conf(
+    n: int,
+    k: int,
+    file_name: str,
+    survivors: list[int] | None = None,
+    out: str | None = None,
+) -> str:
+    if survivors is None:
+        survivors = list(range(n - k, n))  # drop the first n-k (unit-test.sh:3-24)
+    if len(survivors) != k:
+        raise ValueError(f"need exactly k={k} survivors, got {len(survivors)}")
+    if any(s < 0 or s >= n for s in survivors):
+        raise ValueError(f"survivor index out of range: {survivors}")
+    base = os.path.basename(file_name)
+    out = out or os.path.join(
+        os.path.dirname(file_name) or ".", f"conf-{n}-{k}-{base}"
+    )
+    write_conf(out, [os.path.basename(chunk_file_name(file_name, s)) for s in survivors])
+    return out
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gpu_rscode_tpu.tools.make_conf",
+        description="generate a decode conf file (erasure scenario)",
+    )
+    ap.add_argument("n", type=int, help="total chunk count")
+    ap.add_argument("k", type=int, help="native chunk count")
+    ap.add_argument("file", help="original file name")
+    ap.add_argument(
+        "--pattern",
+        help="comma-separated surviving chunk indices (default: last k)",
+    )
+    ap.add_argument("-o", "--out", help="output conf path")
+    args = ap.parse_args(argv)
+    survivors = (
+        [int(x) for x in args.pattern.split(",")] if args.pattern else None
+    )
+    out = make_conf(args.n, args.k, args.file, survivors, args.out)
+    print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

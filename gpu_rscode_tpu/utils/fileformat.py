@@ -1,0 +1,89 @@
+"""On-disk formats: chunk files, .METADATA, conf files.
+
+Byte-compatible with the reference formats (the durable state the encode and
+decode *processes* exchange — SURVEY/reference: ``encode.cu:61-101`` writes
+METADATA, ``encode.cu:434-465`` writes chunks, ``decode.cu:257-319`` parses
+both plus the conf file):
+
+* chunk file ``_<i>_<fileName>``, i in [0, n): i < k natives, i >= k parity;
+  each holds exactly ``chunk_size = ceil(total_size / k)`` bytes (tail chunk
+  zero-padded — deterministic, unlike the reference's uninitialised-heap
+  padding, encode.cu:325-330).
+* ``<fileName>.METADATA`` text: line 1 ``totalSize``; line 2
+  ``parityBlockNum nativeBlockNum``; then (k+p) rows x k cols of the total
+  encoding matrix, identity block first, each entry "%d " and "\n" per row.
+* conf file: k lines, each a surviving chunk filename; the row index is the
+  integer parsed from the digits immediately after the FIRST character
+  (the reference does ``atoi(name + 1)``, decode.cu:305).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import numpy as np
+
+
+def chunk_file_name(file_name: str, index: int) -> str:
+    """``_<i>_<basename>`` next to ``file_name``."""
+    d, base = os.path.split(file_name)
+    return os.path.join(d, f"_{index}_{base}")
+
+
+def metadata_file_name(file_name: str) -> str:
+    return file_name + ".METADATA"
+
+
+def chunk_size_for(total_size: int, native_num: int) -> int:
+    return -(-total_size // native_num)  # ceil
+
+
+def write_metadata(path: str, total_size: int, parity_num: int, native_num: int, total_mat: np.ndarray) -> None:
+    rows = native_num + parity_num
+    assert total_mat.shape == (rows, native_num), total_mat.shape
+    with open(path, "w") as fp:
+        fp.write(f"{total_size}\n")
+        fp.write(f"{parity_num} {native_num}\n")
+        for i in range(rows):
+            fp.write("".join(f"{int(v)} " for v in total_mat[i]) + "\n")
+
+
+def read_metadata(path: str) -> tuple[int, int, int, np.ndarray]:
+    """Returns (total_size, parity_num, native_num, total_matrix)."""
+    with open(path) as fp:
+        tokens = fp.read().split()
+    if len(tokens) < 3:
+        raise ValueError(f"malformed metadata file {path!r}")
+    total_size, parity_num, native_num = int(tokens[0]), int(tokens[1]), int(tokens[2])
+    want = (native_num + parity_num) * native_num
+    mat_tokens = tokens[3 : 3 + want]
+    if len(mat_tokens) != want:
+        raise ValueError(
+            f"metadata matrix truncated: expected {want} entries, got {len(mat_tokens)}"
+        )
+    mat = np.array([int(t) for t in mat_tokens], dtype=np.uint8).reshape(
+        native_num + parity_num, native_num
+    )
+    return total_size, parity_num, native_num, mat
+
+
+def parse_chunk_index(name: str) -> int:
+    """Row index from a chunk file name: integer digits right after the first
+    character (reference semantics: ``atoi(name + 1)``)."""
+    base = os.path.basename(name)
+    m = re.match(r"\d+", base[1:])
+    if not m:
+        raise ValueError(f"cannot parse chunk index from {name!r}")
+    return int(m.group(0))
+
+
+def write_conf(path: str, chunk_names: list[str]) -> None:
+    with open(path, "w") as fp:
+        for name in chunk_names:
+            fp.write(name + "\n")
+
+
+def read_conf(path: str) -> list[str]:
+    with open(path) as fp:
+        return [line.strip() for line in fp if line.strip()]

@@ -1,0 +1,66 @@
+"""Phase timing / observability.
+
+Capability parity with the reference's cudaEvent step timing + aggregate
+"total computation" vs "total communication" report (encode.cu:111-163,
+227-232, 254-277; cpu-rs.c:523-532) — reimagined for an async runtime:
+device work is timed by bracketing ``block_until_ready`` fences around
+phases, host IO by wall clock.  The report keeps the reference's
+computation/communication split so numbers are comparable.
+
+For deep profiling use ``jax.profiler.trace`` via the ``profile_dir``
+option on the file APIs (the TPU-native answer to nvprof/ptxas stats).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+
+
+class PhaseTimer:
+    """Accumulates named phase durations; phases tagged 'io'/'transfer' count
+    as communication, the rest as computation."""
+
+    COMM_PHASES = ("read", "write", "transfer", "io", "stage")
+
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self.acc: dict[str, float] = defaultdict(float)
+        self.counts: dict[str, int] = defaultdict(int)
+        self._t0 = time.perf_counter()
+
+    @contextmanager
+    def phase(self, name: str):
+        if not self.enabled:
+            yield
+            return
+        t = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t
+            self.acc[name] += dt
+            self.counts[name] += 1
+
+    def add(self, name: str, seconds: float) -> None:
+        self.acc[name] += seconds
+        self.counts[name] += 1
+
+    @property
+    def total(self) -> float:
+        return time.perf_counter() - self._t0
+
+    def summary(self, data_bytes: int | None = None) -> str:
+        comm = sum(v for k, v in self.acc.items() if any(t in k for t in self.COMM_PHASES))
+        comp = sum(v for k, v in self.acc.items() if not any(t in k for t in self.COMM_PHASES))
+        lines = [
+            f"  {name}: {1e3 * v:.3f} ms  (x{self.counts[name]})"
+            for name, v in sorted(self.acc.items())
+        ]
+        lines.append(f"  total computation: {1e3 * comp:.3f} ms")
+        lines.append(f"  total communication: {1e3 * comm:.3f} ms")
+        lines.append(f"  total wall: {1e3 * self.total:.3f} ms")
+        if data_bytes is not None and self.total > 0:
+            lines.append(f"  throughput: {data_bytes / self.total / 1e9:.3f} GB/s")
+        return "\n".join(lines)

@@ -1,0 +1,68 @@
+"""CLI surface tests — flag compatibility with the reference (main.c:32-164)."""
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu.cli import main
+from gpu_rscode_tpu.tools.make_conf import main as make_conf_main
+
+
+def _mkfile(tmp_path, size, seed=0):
+    path = str(tmp_path / "f.bin")
+    rng = np.random.default_rng(seed)
+    open(path, "wb").write(rng.integers(0, 256, size=size, dtype=np.uint8).tobytes())
+    return path
+
+
+def test_cli_encode_decode_roundtrip(tmp_path, capsys):
+    path = _mkfile(tmp_path, 4097)
+    orig = open(path, "rb").read()
+    assert main(["-k", "4", "-n", "6", "-e", path, "--quiet"]) == 0
+    # conf via the tool CLI (unit-test.sh equivalent)
+    assert make_conf_main(["6", "4", path]) == 0
+    conf = capsys.readouterr().out.strip()
+    out = str(tmp_path / "out.bin")
+    assert main(["-d", "-i", path, "-c", conf, "-o", out, "--quiet"]) == 0
+    assert open(out, "rb").read() == orig
+
+
+def test_cli_uppercase_flags(tmp_path):
+    path = _mkfile(tmp_path, 999)
+    assert main(["-K", "3", "-N", "5", "-E", path, "--quiet"]) == 0
+
+
+def test_cli_tuning_flags(tmp_path):
+    path = _mkfile(tmp_path, 70_000)
+    assert main(["-k", "4", "-n", "6", "-e", path, "-s", "3", "-p", "1", "--quiet"]) == 0
+
+
+def test_cli_timing_report(tmp_path, capsys):
+    path = _mkfile(tmp_path, 1000)
+    assert main(["-k", "4", "-n", "6", "-e", path]) == 0
+    out = capsys.readouterr().out
+    assert "total computation" in out and "total communication" in out
+
+
+def test_cli_help(capsys):
+    assert main(["-h"]) == 0
+    assert "Usage" in capsys.readouterr().out
+
+
+def test_cli_decode_flags_require_d():
+    # -i/-c/-o before -d is a usage error (reference shows help)
+    assert main(["-i", "x", "-c", "y"]) == 2
+
+
+def test_cli_missing_required():
+    assert main(["-k", "4", "-e", "nope"]) == 2  # missing -n
+    assert main(["-d", "-i", "nope"]) == 2  # missing -c
+    assert main([]) == 2
+
+
+def test_cli_n_not_greater_than_k(tmp_path):
+    path = _mkfile(tmp_path, 10)
+    assert main(["-k", "4", "-n", "4", "-e", path, "--quiet"]) == 2
+
+
+def test_cli_missing_file_error():
+    assert main(["-k", "4", "-n", "6", "-e", "/nonexistent/file", "--quiet"]) == 1

@@ -1,0 +1,76 @@
+"""File-format byte compatibility tests (METADATA / conf / chunk naming)."""
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu.models.vandermonde import total_matrix
+from gpu_rscode_tpu.utils.fileformat import (
+    chunk_file_name,
+    chunk_size_for,
+    metadata_file_name,
+    parse_chunk_index,
+    read_conf,
+    read_metadata,
+    write_conf,
+    write_metadata,
+)
+
+
+def test_metadata_golden_bytes(tmp_path):
+    """Exact byte format: '%d\\n', '%d %d\\n', then '%d ' entries + '\\n'
+    per row, identity block first (encode.cu:61-101)."""
+    path = str(tmp_path / "f.METADATA")
+    T = total_matrix(2, 4)
+    write_metadata(path, 1000, 2, 4, T)
+    raw = open(path, "rb").read()
+    want = b"1000\n2 4\n"
+    want += b"1 0 0 0 \n0 1 0 0 \n0 0 1 0 \n0 0 0 1 \n"
+    want += b"1 1 1 1 \n1 2 3 4 \n"
+    assert raw == want
+
+
+def test_metadata_roundtrip(tmp_path):
+    path = str(tmp_path / "x.METADATA")
+    T = total_matrix(4, 10)
+    write_metadata(path, 123456789012, 4, 10, T)  # >2^31: large-file support
+    total, p, k, mat = read_metadata(path)
+    assert (total, p, k) == (123456789012, 4, 10)
+    np.testing.assert_array_equal(mat, T)
+
+
+def test_metadata_truncated_rejected(tmp_path):
+    path = str(tmp_path / "bad.METADATA")
+    open(path, "w").write("100\n2 4\n1 0 0\n")
+    with pytest.raises(ValueError, match="truncated"):
+        read_metadata(path)
+
+
+def test_chunk_naming():
+    assert chunk_file_name("foo.bin", 0).endswith("_0_foo.bin")
+    assert chunk_file_name("/a/b/foo", 12) == "/a/b/_12_foo"
+
+
+def test_parse_chunk_index_reference_semantics():
+    # atoi(name + 1): digits right after the first char (decode.cu:305)
+    assert parse_chunk_index("_0_file") == 0
+    assert parse_chunk_index("_13_file.bin") == 13
+    assert parse_chunk_index("/dir/_7_f") == 7
+    with pytest.raises(ValueError):
+        parse_chunk_index("_x_file")
+
+
+def test_chunk_size_ceil():
+    assert chunk_size_for(100, 4) == 25
+    assert chunk_size_for(101, 4) == 26
+    assert chunk_size_for(1, 10) == 1
+
+
+def test_conf_roundtrip(tmp_path):
+    path = str(tmp_path / "conf")
+    names = ["_2_f", "_3_f", "_4_f", "_5_f"]
+    write_conf(path, names)
+    assert read_conf(path) == names
+
+
+def test_metadata_name():
+    assert metadata_file_name("dir/f.bin") == "dir/f.bin.METADATA"
