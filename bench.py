@@ -1,0 +1,63 @@
+"""Benchmark — encode GB/s at the BASELINE headline config (k=10, n=14).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Baseline: the reference's published GPU encode bandwidth, 1356.835 MB/s
+(Tesla C2050, design.tex:490; BASELINE.md) == 1.356835 GB/s.
+
+Runs on whatever jax.default_backend() provides (the driver runs it on one
+real TPU chip).  Measures steady-state device-side encode throughput
+(file bytes / wall time) over a resident stripe, after one warmup for
+compile — comparable to the reference's "encoding file" kernel bandwidth
+measurement, which also excludes PCIe copies from its MB/s figure.
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main() -> None:
+    import jax
+
+    from gpu_rscode_tpu.models.vandermonde import vandermonde_matrix
+    from gpu_rscode_tpu.ops.gemm import gf_matmul_jit
+
+    k, p = 10, 4
+    m = 64 * 1024 * 1024  # 64 MiB per chunk -> 640 MiB data per stripe
+    backend = jax.default_backend()
+    if backend == "cpu":  # keep CI/dev runs fast; the driver uses the TPU
+        m = 4 * 1024 * 1024
+
+    A = jax.numpy.asarray(vandermonde_matrix(p, k))
+    rng = np.random.default_rng(0)
+    B = jax.device_put(rng.integers(0, 256, size=(k, m), dtype=np.uint8))
+
+    def run():
+        return gf_matmul_jit(A, B, strategy="bitplane")
+
+    run().block_until_ready()  # warmup/compile
+    iters = 10 if backend != "cpu" else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = run()
+    out.block_until_ready()
+    dt = (time.perf_counter() - t0) / iters
+
+    data_bytes = k * m  # the file bytes encoded per stripe
+    gbps = data_bytes / dt / 1e9
+    baseline_gbps = 1.356835
+    print(
+        json.dumps(
+            {
+                "metric": f"encode_bandwidth_k{k}_n{k + p}_{backend}",
+                "value": round(gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(gbps / baseline_gbps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -26,6 +26,7 @@ import os
 import numpy as np
 
 from .codec import RSCodec
+from .parallel.pipeline import AsyncWindow
 from .utils.fileformat import (
     chunk_file_name,
     chunk_size_for,
@@ -60,16 +61,22 @@ def encode_file(
     strategy: str = "bitplane",
     segment_bytes: int = DEFAULT_SEGMENT_BYTES,
     pipeline_depth: int = 2,
+    mesh=None,
+    stripe_sharded: bool = False,
     timer: PhaseTimer | None = None,
 ) -> list[str]:
     """Encode ``file_name`` into n = k + p chunk files plus .METADATA.
 
     Returns the list of files written.  ``pipeline_depth`` is the number of
-    segments allowed in flight (maps the reference's ``-s`` flag).
+    segments allowed in flight (maps the reference's ``-s`` flag).  With a
+    ``mesh``, segments are sharded across devices (see parallel/sharded.py).
     """
     timer = timer or PhaseTimer(enabled=False)
     k, p = native_num, parity_num
-    codec = RSCodec(k, p, generator=generator, strategy=strategy)
+    codec = RSCodec(
+        k, p, generator=generator, strategy=strategy,
+        mesh=mesh, stripe_sharded=stripe_sharded,
+    )
     total_size = os.path.getsize(file_name)
     if total_size == 0:
         raise ValueError(f"refusing to encode empty file {file_name!r}")
@@ -113,20 +120,19 @@ def encode_file(
         return seg
 
     try:
-        in_flight: list[tuple[int, int, object]] = []
-        off = 0
-        while off < chunk:
-            cols = min(seg_cols, chunk - off)
-            with timer.phase("stage segment (io)"):
-                host_seg = gather_segment(off, cols)
-            with timer.phase("encode dispatch"):
-                parity = codec.encode(host_seg)  # async
-            in_flight.append((off, cols, parity))
-            if len(in_flight) >= pipeline_depth:
-                _drain_parity(in_flight.pop(0), parity_files, timer)
-            off += cols
-        while in_flight:
-            _drain_parity(in_flight.pop(0), parity_files, timer)
+        with AsyncWindow(
+            pipeline_depth,
+            lambda tag, fut: _drain_parity((*tag, fut), parity_files, timer),
+        ) as window:
+            off = 0
+            while off < chunk:
+                cols = min(seg_cols, chunk - off)
+                with timer.phase("stage segment (io)"):
+                    host_seg = gather_segment(off, cols)
+                with timer.phase("encode dispatch"):
+                    parity = codec.encode(host_seg)  # async
+                window.push((off, cols), parity)
+                off += cols
     finally:
         for fp in parity_files:
             fp.close()
@@ -157,6 +163,8 @@ def decode_file(
     strategy: str = "bitplane",
     segment_bytes: int = DEFAULT_SEGMENT_BYTES,
     pipeline_depth: int = 2,
+    mesh=None,
+    stripe_sharded: bool = False,
     timer: PhaseTimer | None = None,
 ) -> str:
     """Rebuild ``in_file`` from the k surviving chunks listed in
@@ -193,7 +201,9 @@ def decode_file(
                 )
             maps.append(mm)
 
-    codec = RSCodec(k, p, strategy=strategy)
+    codec = RSCodec(
+        k, p, strategy=strategy, mesh=mesh, stripe_sharded=stripe_sharded
+    )
     with timer.phase("invert matrix"):
         dec_mat = codec.decode_matrix_from(total_mat, rows)
 
@@ -201,10 +211,9 @@ def decode_file(
     seg_cols = _segment_cols(chunk, k, segment_bytes)
     tmp_path = out_path + ".rs_tmp"
     with open(tmp_path, "wb") as out_fp:
-        in_flight: list[tuple[int, int, object]] = []
 
-        def drain(entry):
-            off, cols, rec = entry
+        def drain(tag, rec):
+            off, cols = tag
             with timer.phase("decode compute"):
                 rec_np = np.asarray(rec)
             with timer.phase("write output (io)"):
@@ -216,19 +225,16 @@ def decode_file(
                     out_fp.seek(lo)
                     out_fp.write(rec_np[i, : hi - lo].tobytes())
 
-        off = 0
-        while off < chunk:
-            cols = min(seg_cols, chunk - off)
-            with timer.phase("stage segment (io)"):
-                seg = np.stack([mm[off : off + cols] for mm in maps])
-            with timer.phase("decode dispatch"):
-                rec = codec.decode(dec_mat, seg)  # async
-            in_flight.append((off, cols, rec))
-            if len(in_flight) >= pipeline_depth:
-                drain(in_flight.pop(0))
-            off += cols
-        while in_flight:
-            drain(in_flight.pop(0))
+        with AsyncWindow(pipeline_depth, drain) as window:
+            off = 0
+            while off < chunk:
+                cols = min(seg_cols, chunk - off)
+                with timer.phase("stage segment (io)"):
+                    seg = np.stack([mm[off : off + cols] for mm in maps])
+                with timer.phase("decode dispatch"):
+                    rec = codec.decode(dec_mat, seg)  # async
+                window.push((off, cols), rec)
+                off += cols
         out_fp.truncate(total_size)
     os.replace(tmp_path, out_path)
     return out_path

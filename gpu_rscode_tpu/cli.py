@@ -31,8 +31,10 @@ If -o is not set, the original file name is used as the output file name.
 Performance-tuning options:
 [-p|-P]: column-tile size hint for the GF-GEMM kernel
 [-s|-S]: pipeline depth (segments in flight, default 2)
-Extensions: [--generator vandermonde|cauchy] [--strategy bitplane|table]
+Extensions: [--generator vandermonde|cauchy] [--strategy bitplane|table|pallas]
             [--segment-bytes N] [--quiet] [--profile-dir DIR]
+            [--devices N] [--stripe S]  (shard over a device mesh;
+            S > 1 additionally shards the stripe/k axis)
 """
 
 
@@ -48,7 +50,15 @@ def main(argv: list[str] | None = None) -> int:
         opts, extra = getopt.getopt(
             argv,
             "S:s:P:p:K:k:N:n:E:e:I:i:C:c:O:o:DdHh",
-            ["generator=", "strategy=", "segment-bytes=", "quiet", "profile-dir="],
+            [
+                "generator=",
+                "strategy=",
+                "segment-bytes=",
+                "quiet",
+                "profile-dir=",
+                "devices=",
+                "stripe=",
+            ],
         )
     except getopt.GetoptError as e:
         return _fail(f"rs: {e}")
@@ -64,6 +74,8 @@ def main(argv: list[str] | None = None) -> int:
     segment_bytes = None
     quiet = False
     profile_dir = None
+    n_devices = 0
+    stripe = 1
 
     for flag, val in opts:
         f = flag.lower()
@@ -101,6 +113,10 @@ def main(argv: list[str] | None = None) -> int:
             quiet = True
         elif f == "--profile-dir":
             profile_dir = val
+        elif f == "--devices":
+            n_devices = int(val)
+        elif f == "--stripe":
+            stripe = int(val)
 
     if op is None:
         return _fail("rs: choose encode (-e) or decode (-d)")
@@ -109,6 +125,13 @@ def main(argv: list[str] | None = None) -> int:
     from . import api
 
     kwargs = dict(strategy=strategy, pipeline_depth=max(1, pipeline_depth))
+    if stripe > 1 and not n_devices:
+        return _fail("rs: --stripe requires --devices")
+    if n_devices:
+        from .parallel.mesh import make_mesh
+
+        kwargs["mesh"] = make_mesh(n_devices, stripe=stripe)
+        kwargs["stripe_sharded"] = stripe > 1
     if segment_bytes:
         kwargs["segment_bytes"] = segment_bytes
     elif tile_hint:

@@ -36,6 +36,8 @@ class RSCodec:
         w: int = 8,
         generator: str = "vandermonde",
         strategy: Strategy = "bitplane",
+        mesh=None,
+        stripe_sharded: bool = False,
     ):
         if native_num < 1 or parity_num < 0:
             raise ValueError(f"bad (k={native_num}, p={parity_num})")
@@ -45,6 +47,17 @@ class RSCodec:
         self.parity_num = parity_num
         self.strategy: Strategy = strategy
         self.generator = generator
+        self.mesh = mesh
+        self.stripe_sharded = stripe_sharded
+        if mesh is not None:
+            from .parallel.mesh import COLS, STRIPE
+
+            self._cols_size = mesh.shape[COLS]
+            if stripe_sharded and native_num % mesh.shape[STRIPE]:
+                raise ValueError(
+                    f"k={native_num} not divisible by stripe axis "
+                    f"({mesh.shape[STRIPE]} devices)"
+                )
         gen = generator_matrix(generator, parity_num, native_num, self.gf)
         eye = np.eye(native_num, dtype=self.gf.dtype)
         self.total_matrix = np.concatenate([eye, gen], axis=0)  # (n, k)
@@ -63,11 +76,31 @@ class RSCodec:
         """(k, m) natives -> (p, m) parity.  Systematic: natives pass through
         unchanged, only parity is computed (the reference's encode kernel has
         the same shape: (n-k) x k coefficient block, matrix.cu:767-776)."""
-        return gf_matmul_jit(self.parity_block, data, w=self.w, strategy=self.strategy)
+        return self._matmul(self.parity_block, data)
 
     def decode(self, decode_mat, chunks):
         """(k, k) recovery matrix x (k, m) surviving chunks -> (k, m) natives."""
-        return gf_matmul_jit(decode_mat, chunks, w=self.w, strategy=self.strategy)
+        return self._matmul(decode_mat, chunks)
+
+    def _matmul(self, A, B):
+        if self.mesh is None:
+            return gf_matmul_jit(A, B, w=self.w, strategy=self.strategy)
+        from .parallel.sharded import put_sharded, sharded_gf_matmul
+
+        m = B.shape[1]
+        pad = (-m) % self._cols_size
+        if pad:
+            B = np.pad(np.asarray(B), ((0, 0), (0, pad)))
+        Bd = put_sharded(B, self.mesh, self.stripe_sharded)
+        out = sharded_gf_matmul(
+            np.asarray(A),
+            Bd,
+            mesh=self.mesh,
+            w=self.w,
+            strategy=self.strategy,
+            stripe_sharded=self.stripe_sharded,
+        )
+        return out[:, :m] if pad else out
 
     # ----- decode-matrix construction (host) --------------------------------
 

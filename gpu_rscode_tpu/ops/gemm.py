@@ -39,7 +39,7 @@ import numpy as np
 from .gf import get_field
 from .gf_jax import tables
 
-Strategy = Literal["bitplane", "table"]
+Strategy = Literal["bitplane", "table", "pallas"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -135,6 +135,10 @@ def gf_matmul(
         return gf_matmul_bitplane(A, B, w, dot_dtype)
     if strategy == "table":
         return gf_matmul_table(A, B, w)
+    if strategy == "pallas":
+        from .pallas_gemm import gf_matmul_pallas
+
+        return gf_matmul_pallas(A, B, w)
     raise ValueError(f"unknown strategy {strategy!r}")
 
 

@@ -1,0 +1,36 @@
+"""Device-mesh construction helpers.
+
+The framework's two parallel axes (SURVEY §2 parallelism items 1-2,
+re-expressed for a TPU slice):
+
+* ``cols`` — the chunk-column axis.  Embarrassingly parallel (the
+  reference's per-GPU byte-range split, encode.cu:368-380): every device
+  holds a column slice of ALL stripe rows; no communication ever.
+* ``stripe`` — the k (stripe-row) axis, used for wide stripes (k=128 class
+  configs) where one device shouldn't hold all k rows.  The XOR-accumulation
+  across devices becomes an integer ``psum`` over bit-plane partials riding
+  ICI (see :mod:`.sharded`).
+
+A 1-D mesh uses ``cols`` only; a 2-D mesh ``(stripe, cols)`` composes both.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+COLS, STRIPE = "cols", "stripe"
+
+
+def make_mesh(n_devices: int | None = None, stripe: int = 1) -> Mesh:
+    """Mesh over the first ``n_devices`` devices, shaped
+    ``(stripe, n_devices // stripe)`` with axes ``(STRIPE, COLS)``."""
+    devs = jax.devices()
+    n = n_devices or len(devs)
+    if n > len(devs):
+        raise ValueError(f"asked for {n} devices, have {len(devs)}")
+    if n % stripe:
+        raise ValueError(f"{n} devices not divisible by stripe={stripe}")
+    arr = np.array(devs[:n]).reshape(stripe, n // stripe)
+    return Mesh(arr, (STRIPE, COLS))

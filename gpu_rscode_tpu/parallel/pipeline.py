@@ -1,0 +1,54 @@
+"""Host<->HBM streaming pipeline — the CUDA-stream analog.
+
+The reference overlaps PCIe copies with kernels by splitting each device's
+slice ``streamNum`` ways and issuing H2D -> kernel -> D2H depth-first per
+stream (encode.cu:165-218).  On TPU the runtime is already asynchronous:
+``device_put`` and jitted dispatch return futures, and compute overlaps
+host work automatically.  What still needs managing is *backpressure* — how
+many segments may be in flight before the host blocks on results — and
+that is exactly what :class:`AsyncWindow` provides (its ``depth`` is the
+``-s`` flag).  For mesh runs the sharded placement happens in
+``codec._matmul`` via ``put_sharded``, inside the same window, so the H2D
+of segment i+1 overlaps compute of segment i.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+from typing import Any, Generic, TypeVar
+
+T = TypeVar("T")
+
+
+class AsyncWindow(Generic[T]):
+    """Bounded window of in-flight async results.
+
+    ``push(tag, future)`` enqueues; once more than ``depth`` are pending the
+    oldest is drained through ``consume(tag, future)`` (which should block
+    on the future — e.g. ``np.asarray`` — and commit the result).  ``flush``
+    drains the rest in order.
+    """
+
+    def __init__(self, depth: int, consume: Callable[[Any, T], None]):
+        self.depth = max(1, depth)
+        self.consume = consume
+        self._pending: list[tuple[Any, T]] = []
+
+    def push(self, tag: Any, future: T) -> None:
+        self._pending.append((tag, future))
+        while len(self._pending) >= self.depth:
+            self.consume(*self._pending.pop(0))
+
+    def flush(self) -> None:
+        while self._pending:
+            self.consume(*self._pending.pop(0))
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is None:
+            self.flush()
+        else:
+            self._pending.clear()
+        return False

@@ -32,8 +32,9 @@ assert not _xb._backends, "JAX backends initialised before conftest could force 
 # The axon sitecustomize registers its PJRT factory in every interpreter; its
 # client-create blocks whenever the tunnel is busy, even under
 # JAX_PLATFORMS=cpu.  Deregister it so unit tests never dial the tunnel.
-for _plat in [p for p in getattr(_xb, "_backend_factories", {}) if p not in ("cpu",)]:
-    _xb._backend_factories.pop(_plat, None)
+# Keep the stock "tpu" factory registered (pallas needs the platform known
+# for lowering registration); it is never initialised under JAX_PLATFORMS=cpu.
+_xb._backend_factories.pop("axon", None)
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")  # register() pins this to axon

@@ -1,0 +1,90 @@
+"""Sharded GEMM on the 8-device virtual CPU mesh: results must be identical
+to the single-device oracle for every mesh shape and sharding mode."""
+
+import jax
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu.ops.gf import get_field
+from gpu_rscode_tpu.parallel.mesh import make_mesh
+from gpu_rscode_tpu.parallel.sharded import put_sharded, sharded_gf_matmul
+
+GF = get_field(8)
+
+
+def _case(p, k, m, seed=0):
+    rng = np.random.default_rng(seed)
+    A = rng.integers(0, 256, size=(p, k), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(k, m), dtype=np.uint8)
+    return A, B, GF.matmul(A, B)
+
+
+def test_devices_available():
+    assert len(jax.devices()) == 8, "conftest must provide 8 virtual devices"
+
+
+@pytest.mark.parametrize("strategy", ["bitplane", "table", "pallas"])
+def test_cols_sharding_matches_oracle(strategy):
+    mesh = make_mesh(8)
+    A, B, want = _case(4, 10, 8 * 512, seed=1)
+    Bd = put_sharded(B, mesh)
+    got = np.asarray(
+        sharded_gf_matmul(A, Bd, mesh=mesh, strategy=strategy)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("stripe,k", [(2, 8), (4, 32), (8, 128)])
+def test_stripe_sharding_wide_k(stripe, k):
+    """Wide-stripe configs: contraction axis sharded, psum over ICI."""
+    mesh = make_mesh(8, stripe=stripe)
+    A, B, want = _case(4, k, (8 // stripe) * 256, seed=k)
+    Bd = put_sharded(B, mesh, stripe_sharded=True)
+    got = np.asarray(
+        sharded_gf_matmul(A, Bd, mesh=mesh, stripe_sharded=True)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_wide_stripe_k128_baseline_config():
+    """BASELINE config 4: (k=128, n=144) wide stripe over 8 devices."""
+    mesh = make_mesh(8, stripe=8)
+    A, B, want = _case(16, 128, 256, seed=99)
+    Bd = put_sharded(B, mesh, stripe_sharded=True)
+    got = np.asarray(
+        sharded_gf_matmul(A, Bd, mesh=mesh, stripe_sharded=True)
+    )
+    np.testing.assert_array_equal(got, want)
+
+
+def test_decode_through_sharded_gemm():
+    """Full sharded round-trip: encode, erase, invert, decode on the mesh."""
+    from gpu_rscode_tpu.models.vandermonde import total_matrix
+    from gpu_rscode_tpu.ops.inverse import invert_matrix
+
+    k, p, m = 10, 4, 8 * 256
+    mesh = make_mesh(8)
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=(k, m), dtype=np.uint8)
+    T = total_matrix(p, k)
+    code = np.asarray(sharded_gf_matmul(T, put_sharded(data, mesh), mesh=mesh))
+    surv = list(range(p, p + k))
+    inv = invert_matrix(T[surv])
+    rec = np.asarray(
+        sharded_gf_matmul(inv, put_sharded(code[surv], mesh), mesh=mesh)
+    )
+    np.testing.assert_array_equal(rec, data)
+
+
+def test_uneven_cols_rejected_or_correct():
+    """m not divisible by the cols axis: shard_map requires even sharding;
+    the API contract is that callers pad to the mesh — verify the helpful
+    error rather than silent corruption."""
+    mesh = make_mesh(8)
+    A, B, want = _case(2, 4, 1001, seed=7)  # 1001 % 8 != 0: genuinely uneven
+    try:
+        Bd = put_sharded(B, mesh)
+        got = np.asarray(sharded_gf_matmul(A, Bd, mesh=mesh))
+    except ValueError:
+        return  # acceptable: explicit error
+    np.testing.assert_array_equal(got, want)
