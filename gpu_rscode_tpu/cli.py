@@ -31,7 +31,8 @@ If -o is not set, the original file name is used as the output file name.
 Performance-tuning options:
 [-p|-P]: column-tile size hint for the GF-GEMM kernel
 [-s|-S]: pipeline depth (segments in flight, default 2)
-Extensions: [--generator vandermonde|cauchy] [--strategy bitplane|table|pallas]
+Extensions: [--generator vandermonde|cauchy]
+            [--strategy bitplane|table|pallas|cpu]  (cpu = native host codec)
             [--segment-bytes N] [--quiet] [--profile-dir DIR]
             [--devices N] [--stripe S]  (shard over a device mesh;
             S > 1 additionally shards the stripe/k axis)

@@ -83,6 +83,18 @@ class RSCodec:
         return self._matmul(decode_mat, chunks)
 
     def _matmul(self, A, B):
+        if self.strategy == "cpu":
+            # Native host codec (the CPU-RS oracle role, cpu-rs.c) — no
+            # device involved; useful as differential baseline and fallback.
+            if self.w != 8:
+                raise ValueError("strategy='cpu' supports GF(2^8) only")
+            if self.mesh is not None:
+                raise ValueError(
+                    "strategy='cpu' is host-only; it cannot run on a device mesh"
+                )
+            from . import native
+
+            return native.gemm(np.asarray(A), np.asarray(B))
         if self.mesh is None:
             return gf_matmul_jit(A, B, w=self.w, strategy=self.strategy)
         from .parallel.sharded import put_sharded, sharded_gf_matmul

@@ -1,0 +1,183 @@
+"""ctypes bindings for the native host runtime (rs_native.cpp).
+
+The shared library is built on first use with g++ (cached next to the
+source; rebuilt when the source is newer).  Every entry point has a NumPy
+fallback so the framework works on machines without a toolchain — the
+native path is a performance feature, the Python path is the contract.
+
+Maps the reference's native host layer: CPU codec oracle (cpu-rs.c), host
+inverter (cpu-decode.c), staging copies (encode.cu:389-398).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "rs_native.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "librs_native.so")
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_build_failed = False
+
+
+class NativeUnavailable(RuntimeError):
+    pass
+
+
+def _build() -> str:
+    # Compile to a pid-suffixed temp and atomically rename so concurrent
+    # processes never dlopen a half-written .so.
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = [
+        "g++", "-O3", "-march=native", "-shared", "-fPIC", "-std=c++17",
+        "-o", tmp, _SRC, "-lpthread",
+    ]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, text=True)
+        os.replace(tmp, _SO)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+    return _SO
+
+
+def get_lib() -> ctypes.CDLL:
+    """Load (building if needed) the native library; raises
+    NativeUnavailable if no toolchain."""
+    global _lib, _build_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _build_failed:
+            raise NativeUnavailable("native build failed earlier this session")
+        try:
+            if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+                _build()
+            lib = ctypes.CDLL(_SO)
+        except (OSError, subprocess.CalledProcessError) as e:
+            _build_failed = True
+            raise NativeUnavailable(f"cannot build/load rs_native: {e}") from e
+
+        u8p = np.ctypeslib.ndpointer(dtype=np.uint8, flags="C_CONTIGUOUS")
+        lib.rs_gf_init.restype = ctypes.c_int
+        lib.rs_gemm.argtypes = [u8p, u8p, u8p, ctypes.c_int, ctypes.c_int,
+                                ctypes.c_longlong, ctypes.c_int]
+        lib.rs_gemm.restype = None
+        lib.rs_invert.argtypes = [u8p, u8p, ctypes.c_int]
+        lib.rs_invert.restype = ctypes.c_int
+        lib.rs_stripe_read.argtypes = [
+            ctypes.c_char_p, u8p, ctypes.c_longlong, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_longlong, ctypes.c_longlong,
+        ]
+        lib.rs_stripe_read.restype = ctypes.c_longlong
+        lib.rs_scatter_write.argtypes = [
+            ctypes.POINTER(ctypes.c_int), u8p, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_longlong,
+        ]
+        lib.rs_scatter_write.restype = ctypes.c_int
+        lib.rs_gf_init()
+        _lib = lib
+        return lib
+
+
+def available() -> bool:
+    try:
+        get_lib()
+        return True
+    except NativeUnavailable:
+        return False
+
+
+def gemm(A: np.ndarray, B: np.ndarray, nthreads: int = 0) -> np.ndarray:
+    """Native GF(256) GEMM; NumPy-oracle fallback when no toolchain."""
+    A = np.ascontiguousarray(A, dtype=np.uint8)
+    B = np.ascontiguousarray(B, dtype=np.uint8)
+    p, k = A.shape
+    k2, m = B.shape
+    assert k == k2, (A.shape, B.shape)
+    try:
+        lib = get_lib()
+    except NativeUnavailable:
+        from ..ops.gf import get_field
+
+        return get_field(8).matmul(A, B)
+    C = np.empty((p, m), dtype=np.uint8)
+    lib.rs_gemm(A, B, C, p, k, m, nthreads or os.cpu_count() or 1)
+    return C
+
+
+def invert(M: np.ndarray) -> np.ndarray:
+    """Native Gauss-Jordan inverse; raises SingularMatrixError if singular."""
+    from ..ops.inverse import SingularMatrixError, invert_matrix
+
+    M = np.ascontiguousarray(M, dtype=np.uint8)
+    k = M.shape[0]
+    try:
+        lib = get_lib()
+    except NativeUnavailable:
+        return invert_matrix(M)
+    out = np.empty((k, k), dtype=np.uint8)
+    if lib.rs_invert(M, out, k) != 0:
+        raise SingularMatrixError("matrix not invertible (native)")
+    return out
+
+
+def stripe_read(
+    path: str,
+    chunk: int,
+    k: int,
+    off: int,
+    cols: int,
+    total_size: int,
+    fallback_src: np.ndarray | None = None,
+) -> np.ndarray:
+    """(k, cols) stripe segment of a file via native pread.
+
+    ``fallback_src``: an already-open memmap of ``path`` used when the
+    native library is unavailable (avoids re-mapping the file per segment).
+    """
+    dst = np.empty((k, cols), dtype=np.uint8)
+    try:
+        lib = get_lib()
+    except NativeUnavailable:
+        src = (
+            fallback_src
+            if fallback_src is not None
+            else np.memmap(path, dtype=np.uint8, mode="r")
+        )
+        dst[:] = 0
+        for i in range(k):
+            lo = i * chunk + off
+            hi = min(lo + cols, (i + 1) * chunk, total_size)
+            if lo < hi:
+                dst[i, : hi - lo] = src[lo:hi]
+        return dst
+    got = lib.rs_stripe_read(path.encode(), dst, chunk, k, off, cols, total_size)
+    if got < 0:
+        raise OSError(f"rs_stripe_read failed for {path!r} (I/O error or truncated file)")
+    return dst
+
+
+def scatter_write(files, arr: np.ndarray, off: int) -> None:
+    """Write each row of (p, cols) ``arr`` to the matching open binary file
+    at byte offset ``off`` (native pwrite; Python seek/write fallback)."""
+    arr = np.ascontiguousarray(arr, dtype=np.uint8)
+    p, cols = arr.shape
+    assert len(files) == p
+    try:
+        lib = get_lib()
+    except NativeUnavailable:
+        for fp, row in zip(files, arr):
+            fp.seek(off)
+            fp.write(row.tobytes())
+        return
+    for fp in files:
+        fp.flush()  # nothing buffered may straddle the raw pwrite below
+    fds = (ctypes.c_int * p)(*[fp.fileno() for fp in files])
+    if lib.rs_scatter_write(fds, arr, p, cols, off) != 0:
+        raise OSError("rs_scatter_write failed (short write)")

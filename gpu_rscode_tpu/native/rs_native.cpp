@@ -1,0 +1,203 @@
+// rs_native — host-side native runtime: CPU GF(2^8) codec + striped file IO.
+//
+// Role parity with the reference's C host components, re-designed (not
+// translated): the CPU oracle encoder/decoder (cpu-rs.c — full single-thread
+// codec used as correctness baseline), the host Gauss-Jordan inverter
+// (cpu-decode.c:251-298, the production decode-matrix path), and the
+// pinned-buffer staging copies (encode.cu:389-398) whose TPU-era analog is
+// fast striped pread/pwrite between the filesystem and NumPy buffers.
+//
+// Differences by design:
+//  * multiply uses the full 64 KiB product table (the fastest CPU strategy in
+//    the reference's own cpu-rs-* study) built at init from the primitive
+//    polynomial 0x11D — tables are generated here, not copied from anywhere;
+//  * GEMM is cache-blocked over columns and fans out across std::thread
+//    workers (host-core analog of the reference's pthread-per-GPU split);
+//  * Gauss-Jordan uses row pivoting (correct under zero pivots; the
+//    reference's column-swap variant corrupts its accumulator there);
+//  * everything is exposed extern "C" for ctypes.
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr int kPoly = 0x11D;
+uint8_t g_mul[256][256];
+uint8_t g_inv[256];
+bool g_ready = false;
+
+uint8_t slow_mul(uint32_t a, uint32_t b) {
+  uint32_t r = 0;
+  while (b) {
+    if (b & 1) r ^= a;
+    b >>= 1;
+    a <<= 1;
+    if (a & 0x100) a ^= kPoly;
+  }
+  return static_cast<uint8_t>(r);
+}
+
+void gemm_range(const uint8_t* A, const uint8_t* B, uint8_t* C, int p, int k,
+                long long m, long long lo, long long hi) {
+  constexpr long long kBlock = 4096;  // keep working set in L1/L2
+  for (long long c0 = lo; c0 < hi; c0 += kBlock) {
+    const long long c1 = c0 + kBlock < hi ? c0 + kBlock : hi;
+    for (int i = 0; i < p; ++i) {
+      uint8_t* crow = C + static_cast<long long>(i) * m;
+      std::memset(crow + c0, 0, static_cast<size_t>(c1 - c0));
+      for (int t = 0; t < k; ++t) {
+        const uint8_t a = A[i * k + t];
+        if (a == 0) continue;
+        const uint8_t* mrow = g_mul[a];
+        const uint8_t* brow = B + static_cast<long long>(t) * m;
+        if (a == 1) {
+          for (long long c = c0; c < c1; ++c) crow[c] ^= brow[c];
+        } else {
+          for (long long c = c0; c < c1; ++c) crow[c] ^= mrow[brow[c]];
+        }
+      }
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+int rs_gf_init(void) {
+  if (g_ready) return 0;
+  for (int a = 0; a < 256; ++a)
+    for (int b = 0; b <= a; ++b) g_mul[a][b] = g_mul[b][a] = slow_mul(a, b);
+  for (int a = 1; a < 256; ++a)
+    for (int b = 1; b < 256; ++b)
+      if (g_mul[a][b] == 1) {
+        g_inv[a] = static_cast<uint8_t>(b);
+        break;
+      }
+  g_ready = true;
+  return 0;
+}
+
+// C[p x m] = A[p x k] . B[k x m] over GF(256), XOR-accumulated.
+void rs_gemm(const uint8_t* A, const uint8_t* B, uint8_t* C, int p, int k,
+             long long m, int nthreads) {
+  rs_gf_init();
+  if (nthreads <= 1 || m < (1 << 16)) {
+    gemm_range(A, B, C, p, k, m, 0, m);
+    return;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  if (hw == 0) hw = 1;
+  const int nt =
+      nthreads < static_cast<int>(hw) ? nthreads : static_cast<int>(hw);
+  std::vector<std::thread> workers;
+  const long long step = (m + nt - 1) / nt;
+  for (int w = 0; w < nt; ++w) {
+    const long long lo = w * step;
+    const long long hi = lo + step < m ? lo + step : m;
+    if (lo >= hi) break;
+    workers.emplace_back(gemm_range, A, B, C, p, k, m, lo, hi);
+  }
+  for (auto& th : workers) th.join();
+}
+
+// Gauss-Jordan inverse with row pivoting.  0 on success, -1 if singular.
+int rs_invert(const uint8_t* M, uint8_t* out, int k) {
+  rs_gf_init();
+  std::vector<uint8_t> a(M, M + static_cast<size_t>(k) * k);
+  std::vector<uint8_t> r(static_cast<size_t>(k) * k, 0);
+  for (int i = 0; i < k; ++i) r[i * k + i] = 1;
+  for (int col = 0; col < k; ++col) {
+    int piv = -1;
+    for (int row = col; row < k; ++row)
+      if (a[row * k + col]) {
+        piv = row;
+        break;
+      }
+    if (piv < 0) return -1;
+    if (piv != col) {
+      for (int j = 0; j < k; ++j) {
+        std::swap(a[col * k + j], a[piv * k + j]);
+        std::swap(r[col * k + j], r[piv * k + j]);
+      }
+    }
+    const uint8_t inv_p = g_inv[a[col * k + col]];
+    for (int j = 0; j < k; ++j) {
+      a[col * k + j] = g_mul[a[col * k + j]][inv_p];
+      r[col * k + j] = g_mul[r[col * k + j]][inv_p];
+    }
+    for (int row = 0; row < k; ++row) {
+      if (row == col) continue;
+      const uint8_t f = a[row * k + col];
+      if (!f) continue;
+      const uint8_t* fr = g_mul[f];
+      for (int j = 0; j < k; ++j) {
+        a[row * k + j] ^= fr[a[col * k + j]];
+        r[row * k + j] ^= fr[r[col * k + j]];
+      }
+    }
+  }
+  std::memcpy(out, r.data(), static_cast<size_t>(k) * k);
+  return 0;
+}
+
+// Gather the k stripe rows of a file segment into dst[k x cols] with pread
+// (one syscall per row), zero-padding past EOF / chunk end.  Returns bytes
+// read, or -1 on open failure.  This is the host staging hot path for
+// encode: it replaces k Python slice-copies per segment.
+long long rs_stripe_read(const char* path, uint8_t* dst, long long chunk,
+                         int k, long long off, long long cols,
+                         long long total_size) {
+  const int fd = open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  long long got_total = 0;
+  for (int i = 0; i < k; ++i) {
+    uint8_t* row = dst + static_cast<long long>(i) * cols;
+    const long long lo = static_cast<long long>(i) * chunk + off;
+    long long hi = lo + cols;
+    const long long chunk_end = static_cast<long long>(i + 1) * chunk;
+    if (hi > chunk_end) hi = chunk_end;
+    if (hi > total_size) hi = total_size;
+    long long want = hi - lo;
+    if (want < 0) want = 0;
+    long long done = 0;
+    while (done < want) {
+      const ssize_t n = pread(fd, row + done, static_cast<size_t>(want - done),
+                              lo + done);
+      if (n <= 0) {  // error or unexpected EOF: fail loudly, never zero-fill
+        close(fd);   // silently (zeroed data would encode corrupt parity)
+        return -1;
+      }
+      done += n;
+    }
+    got_total += done;
+    if (done < cols) std::memset(row + done, 0, static_cast<size_t>(cols - done));
+  }
+  close(fd);
+  return got_total;
+}
+
+// Scatter p parity row segments to p files at offset off (pwrite).
+// fds: open file descriptors.  Returns 0, or -1 on short write.
+int rs_scatter_write(const int* fds, const uint8_t* src, int p,
+                     long long cols, long long off) {
+  for (int i = 0; i < p; ++i) {
+    const uint8_t* row = src + static_cast<long long>(i) * cols;
+    long long done = 0;
+    while (done < cols) {
+      const ssize_t n = pwrite(fds[i], row + done,
+                               static_cast<size_t>(cols - done), off + done);
+      if (n <= 0) return -1;
+      done += n;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -39,7 +39,7 @@ import numpy as np
 from .gf import get_field
 from .gf_jax import tables
 
-Strategy = Literal["bitplane", "table", "pallas"]
+Strategy = Literal["bitplane", "table", "pallas", "cpu"]
 
 
 @functools.lru_cache(maxsize=None)

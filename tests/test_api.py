@@ -125,3 +125,28 @@ def test_cauchy_generator_roundtrip(tmp_path):
     out = str(tmp_path / "o")
     api.decode_file(path, conf, out)
     assert open(out, "rb").read() == orig
+
+
+def test_cpu_strategy_roundtrip(tmp_path):
+    """The native host codec path (CPU-RS oracle role) end-to-end."""
+    path = _mkfile(tmp_path, 7777, seed=8)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2, strategy="cpu")
+    conf = make_conf(6, 4, path)
+    out = str(tmp_path / "o")
+    api.decode_file(path, conf, out, strategy="cpu")
+    assert open(out, "rb").read() == orig
+
+
+def test_cpu_strategy_chunks_match_device_strategy(tmp_path):
+    """Bit-exactness contract: native CPU codec and the TPU bitplane path
+    must produce identical parity bytes (the reference's GPU/CPU padding
+    divergence is exactly what this guards against)."""
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    path = _mkfile(tmp_path, 10_001, seed=9)
+    api.encode_file(path, 4, 2, strategy="cpu")
+    cpu = [open(chunk_file_name(path, i), "rb").read() for i in range(6)]
+    api.encode_file(path, 4, 2, strategy="bitplane")
+    dev = [open(chunk_file_name(path, i), "rb").read() for i in range(6)]
+    assert cpu == dev

@@ -1,0 +1,87 @@
+"""Native C++ runtime: differential tests vs the NumPy oracle."""
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import native
+from gpu_rscode_tpu.ops.gf import get_field
+from gpu_rscode_tpu.ops.inverse import SingularMatrixError, invert_matrix
+
+GF = get_field(8)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="no C++ toolchain"
+)
+
+
+@pytest.mark.parametrize("p,k,m", [(2, 4, 1000), (4, 10, 70_000), (1, 1, 5)])
+def test_native_gemm_vs_oracle(p, k, m):
+    rng = np.random.default_rng(p + m)
+    A = rng.integers(0, 256, size=(p, k), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(k, m), dtype=np.uint8)
+    np.testing.assert_array_equal(native.gemm(A, B), GF.matmul(A, B))
+
+
+def test_native_gemm_multithreaded_matches():
+    rng = np.random.default_rng(3)
+    A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(10, 300_000), dtype=np.uint8)
+    np.testing.assert_array_equal(
+        native.gemm(A, B, nthreads=4), native.gemm(A, B, nthreads=1)
+    )
+
+
+@pytest.mark.parametrize("k", [1, 4, 10, 32])
+def test_native_invert_vs_host(k):
+    rng = np.random.default_rng(k)
+    for _ in range(5):
+        M = rng.integers(0, 256, size=(k, k), dtype=np.uint8)
+        try:
+            want = invert_matrix(M)
+        except SingularMatrixError:
+            with pytest.raises(SingularMatrixError):
+                native.invert(M)
+            continue
+        np.testing.assert_array_equal(native.invert(M), want)
+
+
+def test_native_invert_zero_pivot():
+    M = np.array([[0, 1, 2], [1, 2, 3], [4, 5, 6]], dtype=np.uint8)
+    inv = native.invert(M)
+    np.testing.assert_array_equal(GF.matmul(M, inv), np.eye(3, dtype=np.uint8))
+
+
+def test_native_invert_singular():
+    with pytest.raises(SingularMatrixError):
+        native.invert(np.array([[1, 2], [1, 2]], dtype=np.uint8))
+
+
+def test_stripe_read_matches_python(tmp_path):
+    path = str(tmp_path / "f")
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=10_001, dtype=np.uint8)
+    open(path, "wb").write(data.tobytes())
+    k, chunk = 4, 2501  # ceil(10001/4)
+    for off, cols in [(0, 1000), (2000, 501), (2400, 200), (0, 2501)]:
+        got = native.stripe_read(path, chunk, k, off, cols, 10_001)
+        want = np.zeros((k, cols), dtype=np.uint8)
+        for i in range(k):
+            lo = i * chunk + off
+            hi = min(lo + cols, (i + 1) * chunk, 10_001)
+            if lo < hi:
+                want[i, : hi - lo] = data[lo:hi]
+        np.testing.assert_array_equal(got, want)
+
+
+def test_native_cpu_roundtrip():
+    """Full CPU-only codec round-trip (the CPU-RS oracle role)."""
+    from gpu_rscode_tpu.models.vandermonde import total_matrix
+
+    k, p, m = 10, 4, 50_000
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, size=(k, m), dtype=np.uint8)
+    T = total_matrix(p, k)
+    code = np.concatenate([data, native.gemm(T[k:], data)], axis=0)
+    surv = list(range(p, p + k))
+    rec = native.gemm(native.invert(T[surv]), code[surv])
+    np.testing.assert_array_equal(rec, data)
