@@ -8,9 +8,10 @@ lower-case flags both accepted.  ``-i/-c/-o`` are rejected unless a decode
 was selected first, matching the reference's ordering rule.
 
 Extensions (flagged long options, no reference equivalent):
-``--generator {vandermonde,cauchy}``, ``--strategy {bitplane,table}``,
-``--quiet`` (suppress the timing report), ``--profile-dir DIR``
-(jax.profiler trace output).
+``--generator {vandermonde,cauchy}``,
+``--strategy {bitplane,table,pallas,cpu}``, ``--devices N`` / ``--stripe S``
+(mesh sharding), ``--quiet`` (suppress the timing report),
+``--profile-dir DIR`` (jax.profiler trace output).
 """
 
 from __future__ import annotations

@@ -12,6 +12,7 @@ on-device inverter is available (:func:`..ops.inverse.invert_matrix_jax`).
 
 from __future__ import annotations
 
+import jax
 import numpy as np
 
 from .models.vandermonde import generator_matrix
@@ -49,6 +50,16 @@ class RSCodec:
         self.generator = generator
         self.mesh = mesh
         self.stripe_sharded = stripe_sharded
+        self._pallas_checked = False
+        if strategy == "cpu":
+            # Validate up front: failing mid-stream would leave partial
+            # output files behind.
+            if w != 8:
+                raise ValueError("strategy='cpu' supports GF(2^8) only")
+            if mesh is not None:
+                raise ValueError(
+                    "strategy='cpu' is host-only; it cannot run on a device mesh"
+                )
         if mesh is not None:
             from .parallel.mesh import COLS, STRIPE
 
@@ -86,16 +97,32 @@ class RSCodec:
         if self.strategy == "cpu":
             # Native host codec (the CPU-RS oracle role, cpu-rs.c) — no
             # device involved; useful as differential baseline and fallback.
-            if self.w != 8:
-                raise ValueError("strategy='cpu' supports GF(2^8) only")
-            if self.mesh is not None:
-                raise ValueError(
-                    "strategy='cpu' is host-only; it cannot run on a device mesh"
-                )
             from . import native
 
             return native.gemm(np.asarray(A), np.asarray(B))
         if self.mesh is None:
+            if self.strategy == "pallas":
+                # The fused kernel is a performance feature; a Mosaic
+                # compile/runtime failure must not fail the file operation.
+                # The first dispatch is materialised inside the guard (async
+                # dispatch would otherwise surface the error later, outside
+                # it); subsequent segments run the already-proven executable
+                # fully async.
+                try:
+                    out = gf_matmul_jit(A, B, w=self.w, strategy="pallas")
+                    if not self._pallas_checked:
+                        jax.block_until_ready(out)
+                        self._pallas_checked = True
+                    return out
+                except Exception as e:  # noqa: BLE001 — any backend error
+                    import warnings
+
+                    warnings.warn(
+                        f"pallas GEMM failed ({type(e).__name__}); "
+                        "falling back to the XLA bitplane path",
+                        stacklevel=3,
+                    )
+                    self.strategy = "bitplane"
             return gf_matmul_jit(A, B, w=self.w, strategy=self.strategy)
         from .parallel.sharded import put_sharded, sharded_gf_matmul
 
