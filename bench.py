@@ -1,14 +1,16 @@
 """Benchmark — encode GB/s at the BASELINE headline config (k=10, n=14).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "detail"}.
 Baseline: the reference's published GPU encode bandwidth, 1356.835 MB/s
 (Tesla C2050, design.tex:490; BASELINE.md) == 1.356835 GB/s.
 
-Runs on whatever jax.default_backend() provides (the driver runs it on one
-real TPU chip).  Measures steady-state device-side encode throughput
-(file bytes / wall time) over a resident stripe, after one warmup for
-compile — comparable to the reference's "encoding file" kernel bandwidth
-measurement, which also excludes PCIe copies from its MB/s figure.
+Method: a (k=10, p=4) stripe resident on the device is encoded by each
+available GEMM strategy (fused Pallas kernel first, then the XLA bit-plane
+path segmented to bound HBM, then the table path); every strategy's output
+is verified bit-exact against the native CPU oracle on a sample before its
+time counts.  The reported number is the best verified strategy's
+steady-state device throughput (file bytes / wall), comparable to the
+reference's kernel-bandwidth figure (which likewise excludes PCIe copies).
 """
 
 import json
@@ -16,44 +18,90 @@ import time
 
 import numpy as np
 
+K, P = 10, 4
+BASELINE_GBPS = 1.356835
+
+
+def _verify(out_fn, A, B_host, oracle_slice):
+    got = np.asarray(out_fn())[:, : oracle_slice.shape[1]]
+    if not np.array_equal(got, oracle_slice):
+        raise AssertionError("output mismatch vs CPU oracle")
+
+
+def _time(fn, iters):
+    import jax
+
+    jax.block_until_ready(fn())  # warmup/compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
 
 def main() -> None:
     import jax
 
+    from gpu_rscode_tpu import native
     from gpu_rscode_tpu.models.vandermonde import vandermonde_matrix
     from gpu_rscode_tpu.ops.gemm import gf_matmul_jit
+    from gpu_rscode_tpu.ops.pallas_gemm import gf_matmul_pallas
 
-    k, p = 10, 4
-    m = 64 * 1024 * 1024  # 64 MiB per chunk -> 640 MiB data per stripe
     backend = jax.default_backend()
-    if backend == "cpu":  # keep CI/dev runs fast; the driver uses the TPU
-        m = 4 * 1024 * 1024
+    on_tpu = backend == "tpu"
+    m = (32 * 1024 * 1024) if on_tpu else (2 * 1024 * 1024)  # bytes per chunk
+    seg = 4 * 1024 * 1024  # XLA bitplane segment (bounds HBM expansion)
+    iters = 10 if on_tpu else 3
 
-    A = jax.numpy.asarray(vandermonde_matrix(p, k))
+    A = vandermonde_matrix(P, K)
     rng = np.random.default_rng(0)
-    B = jax.device_put(rng.integers(0, 256, size=(k, m), dtype=np.uint8))
+    B_host = rng.integers(0, 256, size=(K, m), dtype=np.uint8)
+    Ad = jax.device_put(A)
+    Bd = jax.device_put(B_host)
+    sample = native.gemm(A, B_host[:, :4096])  # CPU-oracle verification slab
 
-    def run():
-        return gf_matmul_jit(A, B, strategy="bitplane")
+    def run_pallas():
+        return gf_matmul_pallas(Ad, Bd)
 
-    run().block_until_ready()  # warmup/compile
-    iters = 10 if backend != "cpu" else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = run()
-    out.block_until_ready()
-    dt = (time.perf_counter() - t0) / iters
+    def run_bitplane():
+        outs = [
+            gf_matmul_jit(Ad, Bd[:, off : off + seg], strategy="bitplane")
+            for off in range(0, m, seg)
+        ]
+        return jax.numpy.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
 
-    data_bytes = k * m  # the file bytes encoded per stripe
-    gbps = data_bytes / dt / 1e9
-    baseline_gbps = 1.356835
+    def run_table():
+        outs = [
+            gf_matmul_jit(Ad, Bd[:, off : off + seg], strategy="table")
+            for off in range(0, m, seg)
+        ]
+        return jax.numpy.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+
+    candidates = [("pallas", run_pallas), ("bitplane", run_bitplane), ("table", run_table)]
+    data_bytes = K * m
+    detail = {}
+    best = (None, 0.0)
+    for name, fn in candidates:
+        try:
+            _verify(fn, A, B_host, sample)
+            dt = _time(fn, iters)
+            gbps = data_bytes / dt / 1e9
+            detail[name] = round(gbps, 3)
+            if gbps > best[1]:
+                best = (name, gbps)
+        except Exception as e:
+            detail[name] = f"failed: {type(e).__name__}"
+
+    if best[0] is None:
+        raise SystemExit(f"all strategies failed: {detail}")
     print(
         json.dumps(
             {
-                "metric": f"encode_bandwidth_k{k}_n{k + p}_{backend}",
-                "value": round(gbps, 3),
+                "metric": f"encode_bandwidth_k{K}_n{K + P}_{backend}",
+                "value": round(best[1], 3),
                 "unit": "GB/s",
-                "vs_baseline": round(gbps / baseline_gbps, 2),
+                "vs_baseline": round(best[1] / BASELINE_GBPS, 2),
+                "detail": {"strategy": best[0], **detail},
             }
         )
     )

@@ -1,0 +1,59 @@
+"""Multi-host initialisation — the distributed communication backend hook.
+
+The reference's "communication backend" is PCIe memcpys + pthread barriers
+inside one process (SURVEY §2); it cannot leave one machine.  The TPU build
+scales past a host boundary with the standard JAX runtime: every host runs
+the same SPMD program, `jax.distributed.initialize` wires the hosts into one
+global device mesh, and the identical `shard_map` code from
+:mod:`.sharded` then spans ICI within a slice and DCN across slices — the
+collectives (the stripe-axis ``psum``) are inserted by XLA either way.
+
+Call :func:`initialize` once per process before building meshes.  On a
+single host it is a no-op, so the same entry scripts work everywhere.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> None:
+    """Initialise multi-host JAX if a cluster is configured.
+
+    Arguments default from the standard env vars
+    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``) or the TPU metadata auto-detection built into
+    ``jax.distributed.initialize``.  No-ops on a single-process setup.
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "JAX_COORDINATOR_ADDRESS"
+    )
+    if num_processes is None and os.environ.get("JAX_NUM_PROCESSES"):
+        num_processes = int(os.environ["JAX_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("JAX_PROCESS_ID"):
+        process_id = int(os.environ["JAX_PROCESS_ID"])
+    if coordinator_address is None and num_processes is None:
+        return  # single host
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(stripe: int = 1):
+    """Mesh over ALL devices of the (possibly multi-host) job.
+
+    Lay the stripe axis within hosts where possible so the per-segment
+    psum rides ICI; the cols axis (no communication) is the one that may
+    span DCN.
+    """
+    from .mesh import make_mesh
+
+    return make_mesh(stripe=stripe)

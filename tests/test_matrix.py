@@ -117,3 +117,25 @@ def test_cauchy_all_submatrices_invertible():
         sub = T[list(rows)]
         inv = invert_matrix(sub)  # must never raise
         np.testing.assert_array_equal(GF.matmul(sub, inv), np.eye(k, dtype=np.uint8))
+
+
+def test_known_answer_k4_n6():
+    """Pinned known-answer values for the (k=4, n=6) config — the role of the
+    reference's embedded KAT (hardcoded 4x4 matrices + known inverses in its
+    experimental decoder harness).  Guards against any table/matrix drift."""
+    T = total_matrix(2, 4)
+    np.testing.assert_array_equal(
+        T,
+        np.array(
+            [[1, 0, 0, 0], [0, 1, 0, 0], [0, 0, 1, 0], [0, 0, 0, 1],
+             [1, 1, 1, 1], [1, 2, 3, 4]],
+            dtype=np.uint8,
+        ),
+    )
+    sub = T[[2, 3, 4, 5]]  # survivors after dropping chunks 0 and 1
+    want_inv = np.array(
+        [[244, 2, 245, 244], [245, 3, 244, 244], [1, 0, 0, 0], [0, 1, 0, 0]],
+        dtype=np.uint8,
+    )
+    np.testing.assert_array_equal(invert_matrix(sub), want_inv)
+    np.testing.assert_array_equal(GF.matmul(sub, want_inv), np.eye(4, dtype=np.uint8))

@@ -88,3 +88,34 @@ def test_uneven_cols_rejected_or_correct():
     except ValueError:
         return  # acceptable: explicit error
     np.testing.assert_array_equal(got, want)
+
+
+def test_distributed_initialize_noop_single_host(monkeypatch):
+    """Single-host: initialize() must be a no-op (no coordinator configured)."""
+    from gpu_rscode_tpu.parallel import distributed
+
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+        monkeypatch.delenv(var, raising=False)
+    distributed.initialize()  # must not raise nor call jax.distributed
+
+
+def test_wide_symbol_codec_w4_and_w16():
+    """GF(2^4)/GF(2^16) stripe round-trips through RSCodec (the reference's
+    legacy gf lib supported w in {4,8,16}; its GF(16) 'extend' branch was
+    the fast path — here all widths share the bit-plane kernel)."""
+    import numpy as np
+
+    from gpu_rscode_tpu.codec import RSCodec
+    from gpu_rscode_tpu.ops.gf import get_field
+
+    for w, k, p in ((4, 3, 2), (16, 5, 3)):
+        gf = get_field(w)
+        codec = RSCodec(k, p, w=w, generator="cauchy")
+        rng = np.random.default_rng(w)
+        natives = rng.integers(0, gf.size, size=(k, 200)).astype(gf.dtype)
+        parity = np.asarray(codec.encode(natives))
+        code = np.concatenate([natives, parity.astype(gf.dtype)], axis=0)
+        surv = list(range(p, p + k))
+        dec = codec.decode_matrix(surv)
+        rec = np.asarray(codec.decode(dec, code[surv]))
+        np.testing.assert_array_equal(rec.astype(gf.dtype), natives)
