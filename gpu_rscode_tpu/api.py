@@ -207,32 +207,59 @@ def decode_file(
     with timer.phase("invert matrix"):
         dec_mat = codec.decode_matrix_from(total_mat, rows)
 
+    # Partial-recovery optimisation: surviving NATIVE chunks are already the
+    # answer — copy their bytes straight through and run the recovery GEMM
+    # only for the missing native rows.  The reference always multiplies the
+    # full k x k (decode.cu:89-227); here a 4-of-14 erasure does 4/10 of
+    # that work, and the all-natives scenario does no device work at all.
+    # (For survivor rows that are natives, the corresponding rows of the
+    # inverse are unit vectors, so dropping them is exact, not approximate.)
+    # Only valid when the metadata matrix is systematic (identity top block)
+    # — a foreign encoder may write any matrix, and we trust the file.
+    systematic = np.array_equal(total_mat[:k], np.eye(k, dtype=total_mat.dtype))
+    native_pos = (
+        {r: idx for idx, r in enumerate(rows) if r < k} if systematic else {}
+    )
+    missing = [i for i in range(k) if i not in native_pos]
+    rec_row = {i: j for j, i in enumerate(missing)}
+    dec_missing = dec_mat[missing] if missing else None
+
     out_path = output or in_file
     seg_cols = _segment_cols(chunk, k, segment_bytes)
     tmp_path = out_path + ".rs_tmp"
     with open(tmp_path, "wb") as out_fp:
 
+        def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
+            lo = i * chunk + off
+            if lo >= total_size:
+                return
+            hi = min(lo + cols, total_size)
+            out_fp.seek(lo)
+            out_fp.write(row_bytes[: hi - lo].tobytes())
+
         def drain(tag, rec):
             off, cols = tag
             with timer.phase("decode compute"):
-                rec_np = np.asarray(rec)
+                rec_np = np.asarray(rec) if rec is not None else None
             with timer.phase("write output (io)"):
                 for i in range(k):
-                    lo = i * chunk + off
-                    if lo >= total_size:
-                        continue
-                    hi = min(lo + cols, total_size)
-                    out_fp.seek(lo)
-                    out_fp.write(rec_np[i, : hi - lo].tobytes())
+                    if i in native_pos:
+                        src_row = maps[native_pos[i]][off : off + cols]
+                        write_row(i, off, cols, src_row)
+                    else:
+                        write_row(i, off, cols, rec_np[rec_row[i]])
 
         with AsyncWindow(pipeline_depth, drain) as window:
             off = 0
             while off < chunk:
                 cols = min(seg_cols, chunk - off)
-                with timer.phase("stage segment (io)"):
-                    seg = np.stack([mm[off : off + cols] for mm in maps])
-                with timer.phase("decode dispatch"):
-                    rec = codec.decode(dec_mat, seg)  # async
+                if dec_missing is not None:
+                    with timer.phase("stage segment (io)"):
+                        seg = np.stack([mm[off : off + cols] for mm in maps])
+                    with timer.phase("decode dispatch"):
+                        rec = codec.decode(dec_missing, seg)  # async
+                else:
+                    rec = None  # all natives survived: pure copy
                 window.push((off, cols), rec)
                 off += cols
         out_fp.truncate(total_size)

@@ -21,13 +21,17 @@ def initialize(
     coordinator_address: str | None = None,
     num_processes: int | None = None,
     process_id: int | None = None,
+    auto: bool = False,
 ) -> None:
-    """Initialise multi-host JAX if a cluster is configured.
+    """Initialise multi-host JAX.
 
-    Arguments default from the standard env vars
-    (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
-    ``JAX_PROCESS_ID``) or the TPU metadata auto-detection built into
-    ``jax.distributed.initialize``.  No-ops on a single-process setup.
+    Explicit configuration comes from the arguments or the standard env
+    vars (``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` /
+    ``JAX_PROCESS_ID``); if ANY of the three is present, a full explicit
+    init is performed (jax validates completeness).  ``auto=True`` (or env
+    ``RS_DISTRIBUTED=auto``) requests the Cloud-TPU metadata auto-detection
+    (bare ``jax.distributed.initialize()``).  With neither, this is a
+    no-op — safe to call unconditionally in single-process scripts.
     """
     import jax
 
@@ -38,8 +42,11 @@ def initialize(
         num_processes = int(os.environ["JAX_NUM_PROCESSES"])
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
-    if coordinator_address is None and num_processes is None:
-        return  # single host
+    if auto or os.environ.get("RS_DISTRIBUTED") == "auto":
+        jax.distributed.initialize()
+        return
+    if coordinator_address is None and num_processes is None and process_id is None:
+        return  # single process, nothing configured
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

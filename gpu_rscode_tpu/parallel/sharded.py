@@ -95,6 +95,16 @@ def sharded_gf_matmul(A, B, *, mesh, w=8, strategy="bitplane", stripe_sharded=Fa
 
 
 def put_sharded(B, mesh, stripe_sharded: bool = False):
-    """Place a host (k, m) array on the mesh with the encode sharding."""
+    """Place a host (k, m) array on the mesh with the encode sharding.
+
+    Single-process: ``B`` is the GLOBAL array, device_put scatters it.
+    Multi-process (mesh spans hosts): ``B`` must be this process's LOCAL
+    portion of the global array (each host stages the byte range it owns —
+    the natural layout for multi-host file encode); the global array is
+    assembled from the per-process pieces.
+    """
     spec = P(STRIPE if stripe_sharded else None, COLS)
-    return jax.device_put(B, NamedSharding(mesh, spec))
+    sharding = NamedSharding(mesh, spec)
+    if jax.process_count() > 1:
+        return jax.make_array_from_process_local_data(sharding, B)
+    return jax.device_put(B, sharding)
