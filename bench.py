@@ -102,6 +102,39 @@ def main() -> None:
 
     if best[0] is None:
         raise SystemExit(f"all strategies failed: {detail}")
+
+    # 4-erasure recovery latency (BASELINE's second headline): reconstruct
+    # the P lost natives from the surviving k chunks with the best strategy.
+    from gpu_rscode_tpu.models.vandermonde import total_matrix
+    from gpu_rscode_tpu.ops.inverse import invert_matrix
+
+    T = total_matrix(P, K)
+    surv = list(range(P, P + K))
+    inv_missing = invert_matrix(T[surv])[:P]  # only the lost rows
+    survivors = jax.device_put(
+        np.concatenate([B_host[P:], native.gemm(T[K:], B_host)], axis=0)[: K]
+    )
+    if best[0] == "pallas":
+        def run_decode():
+            return gf_matmul_pallas(jax.device_put(inv_missing), survivors)
+    else:
+        def run_decode():
+            outs = [
+                gf_matmul_jit(
+                    jax.device_put(inv_missing),
+                    survivors[:, off : off + seg],
+                    strategy=best[0],
+                )
+                for off in range(0, m, seg)
+            ]
+            return jax.numpy.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+
+    try:
+        dec_dt = _time(run_decode, max(1, iters // 2))
+        detail["decode_gbps"] = round(data_bytes / dec_dt / 1e9, 3)
+        detail["recovery_latency_ms"] = round(1e3 * dec_dt, 2)
+    except Exception as e:
+        detail["decode"] = f"failed: {type(e).__name__}"
     print(
         json.dumps(
             {

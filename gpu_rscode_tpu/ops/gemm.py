@@ -58,11 +58,16 @@ def expand_bitmatrix_jnp(A: jnp.ndarray, w: int = 8) -> jnp.ndarray:
 
 
 def to_bitplanes(B: jnp.ndarray, w: int = 8) -> jnp.ndarray:
-    """(k, m) GF elements -> (k*w, m) 0/1 planes (bit 0 = LSB first)."""
+    """(k, m) GF elements -> (k*w, m) 0/1 planes (bit 0 = LSB first).
+
+    Stays in the element's own width (uint8/uint16) end to end so the
+    expanded intermediate is 1 byte/plane-element, not 4 — the XLA path
+    materialises this array in HBM, so its dtype is the traffic."""
     k, m = B.shape
-    shifts = jnp.arange(w, dtype=jnp.int32)
-    planes = (B.astype(jnp.int32)[:, None, :] >> shifts[None, :, None]) & 1
-    return planes.reshape(k * w, m)
+    dt = np.dtype(B.dtype) if B.dtype in (jnp.uint8, jnp.uint16) else np.dtype(np.uint16)
+    shifts = jnp.arange(w, dtype=dt)
+    planes = (B.astype(dt)[:, None, :] >> shifts[None, :, None]) & dt.type(1)
+    return planes.reshape(k * w, m).astype(jnp.uint8)
 
 
 def from_bitplanes(Cbits: jnp.ndarray, w: int = 8, dtype=jnp.uint8) -> jnp.ndarray:
