@@ -139,3 +139,23 @@ def test_known_answer_k4_n6():
     )
     np.testing.assert_array_equal(invert_matrix(sub), want_inv)
     np.testing.assert_array_equal(GF.matmul(sub, want_inv), np.eye(4, dtype=np.uint8))
+
+
+def test_invert_batch_matches_host():
+    from gpu_rscode_tpu.ops.inverse import invert_matrix_jax_batch
+
+    rng = np.random.default_rng(77)
+    mats, wants, oks = [], [], []
+    while len(mats) < 6:
+        M = rng.integers(0, 256, size=(5, 5), dtype=np.uint8)
+        try:
+            wants.append(invert_matrix(M))
+            oks.append(True)
+        except SingularMatrixError:
+            continue
+        mats.append(M)
+    mats.append(np.zeros((5, 5), dtype=np.uint8))  # singular tail entry
+    out, ok = invert_matrix_jax_batch(np.stack(mats))
+    assert list(np.asarray(ok)) == [True] * 6 + [False]
+    for got, want in zip(np.asarray(out)[:6], wants):
+        np.testing.assert_array_equal(got.astype(np.uint8), want)
