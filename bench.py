@@ -14,9 +14,10 @@ reference's kernel-bandwidth figure (which likewise excludes PCIe copies).
 """
 
 import json
-import time
 
 import numpy as np
+
+from gpu_rscode_tpu.tools._bench_timing import time_device_fn as _time
 
 K, P = 10, 4
 BASELINE_GBPS = 1.356835
@@ -28,57 +29,6 @@ def _verify(small_fn, oracle_slice):
     got = np.asarray(small_fn())
     if not np.array_equal(got, oracle_slice):
         raise AssertionError("output mismatch vs CPU oracle")
-
-
-def _rt_latency():
-    """Measured dispatch+fetch round-trip of a trivial op.  Under a remote
-    device tunnel (axon) this is tens of ms and must be subtracted, or every
-    throughput number is really a latency number."""
-    import jax
-    import jax.numpy as jnp
-
-    tiny = jax.jit(lambda x: jnp.sum(x))
-    x = jnp.ones((8, 8), jnp.float32)
-    float(tiny(x))
-    ts = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        float(tiny(x))
-        ts.append(time.perf_counter() - t0)
-    return min(ts)
-
-
-def _time(fn, trials=2, target_s=1.5):
-    """Per-call seconds: queue calls back-to-back, force completion with a
-    device-side reduction fetched as a scalar (block_until_ready alone is
-    unreliable over the axon tunnel), subtract the measured round-trip.
-    Iteration count is sized from a single-call estimate so slow strategies
-    don't blow the wall-clock budget."""
-    import jax
-    import jax.numpy as jnp
-
-    reduce_ = jax.jit(lambda x: jnp.sum(x.astype(jnp.int32)))
-    float(reduce_(fn()))  # warmup/compile (incl. the reduction)
-    rt = _rt_latency()
-    t0 = time.perf_counter()
-    float(reduce_(fn()))
-    t1 = max(time.perf_counter() - t0 - rt, 1e-4)
-    # Size the loop so the round-trip is noise (<5%), not the signal; the
-    # cap only bounds pathological cases.
-    target = max(target_s, 20.0 * rt)
-    iters = max(1, min(2000, int(target / t1)))
-    best = float("inf")
-    for _ in range(trials):
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            out = fn()
-        float(reduce_(out))
-        total = time.perf_counter() - t0
-        # If the loop didn't dominate the round-trip the subtraction is
-        # unreliable — report the unsubtracted (conservative) figure.
-        per = (total - rt) / iters if total > 4.0 * rt else total / iters
-        best = min(best, per)
-    return best
 
 
 def main() -> None:

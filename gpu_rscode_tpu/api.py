@@ -28,15 +28,36 @@ import numpy as np
 from .codec import RSCodec
 from .parallel.pipeline import AsyncWindow
 from .utils.fileformat import (
+    append_checksums,
     chunk_file_name,
     chunk_size_for,
+    crc32_of,
     metadata_file_name,
     parse_chunk_index,
+    read_checksums,
     read_conf,
     read_metadata,
     write_metadata,
 )
 from .utils.timing import PhaseTimer
+
+
+class ChunkIntegrityError(ValueError):
+    """A surviving chunk's bytes do not match its recorded CRC32.
+
+    ``bad_chunks`` maps chunk index -> file path, so callers can build a new
+    conf from different survivors (the checksum extension turns silent
+    corruption into a recoverable erasure).
+    """
+
+    def __init__(self, bad_chunks: dict[int, str]):
+        self.bad_chunks = dict(bad_chunks)
+        names = ", ".join(f"{i}:{p}" for i, p in sorted(bad_chunks.items()))
+        super().__init__(
+            f"chunk checksum mismatch (corrupt survivors): {names}; "
+            "pick different survivors in the conf file"
+        )
+
 
 # Default segment sizing: bound host+device working set to ~64 MiB of natives
 # per in-flight segment (k rows x seg_cols bytes).
@@ -63,6 +84,7 @@ def encode_file(
     pipeline_depth: int = 2,
     mesh=None,
     stripe_sharded: bool = False,
+    checksums: bool = False,
     timer: PhaseTimer | None = None,
 ) -> list[str]:
     """Encode ``file_name`` into n = k + p chunk files plus .METADATA.
@@ -70,6 +92,9 @@ def encode_file(
     Returns the list of files written.  ``pipeline_depth`` is the number of
     segments allowed in flight (maps the reference's ``-s`` flag).  With a
     ``mesh``, segments are sharded across devices (see parallel/sharded.py).
+    ``checksums=True`` appends per-chunk CRC32 lines to .METADATA (format
+    extension; decode verifies them automatically when present).  Off by
+    default so the metadata stays byte-identical to the reference's.
     """
     timer = timer or PhaseTimer(enabled=False)
     k, p = native_num, parity_num
@@ -89,17 +114,27 @@ def encode_file(
     # Native chunks: straight copies of the k file ranges, tail zero-padded.
     # Copied in bounded slices so a 100 GB chunk never materialises in RAM.
     copy_step = max(1, segment_bytes)
+    crcs: dict[int, int] | None = {} if checksums else None
     with timer.phase("write natives (io)"):
         for i in range(k):
             name = chunk_file_name(file_name, i)
             lo, hi = i * chunk, min((i + 1) * chunk, total_size)
+            crc = 0
             with open(name, "wb") as fp:
                 for s in range(lo, hi, copy_step):
-                    fp.write(src[s : min(s + copy_step, hi)].tobytes())
+                    buf = src[s : min(s + copy_step, hi)].tobytes()
+                    fp.write(buf)
+                    if crcs is not None:
+                        crc = crc32_of(buf, crc)
                 pad = chunk - max(0, hi - lo)
                 zeros = b"\x00" * min(pad, copy_step)
                 for s in range(0, pad, copy_step):
-                    fp.write(zeros[: min(copy_step, pad - s)])
+                    buf = zeros[: min(copy_step, pad - s)]
+                    fp.write(buf)
+                    if crcs is not None:
+                        crc = crc32_of(buf, crc)
+            if crcs is not None:
+                crcs[i] = crc
             written.append(name)
 
     # Parity chunks: stream segments through the device.
@@ -122,7 +157,7 @@ def encode_file(
     try:
         with AsyncWindow(
             pipeline_depth,
-            lambda tag, fut: _drain_parity((*tag, fut), parity_files, timer),
+            lambda tag, fut: _drain_parity((*tag, fut), parity_files, timer, crcs, k),
         ) as window:
             off = 0
             while off < chunk:
@@ -141,16 +176,23 @@ def encode_file(
         write_metadata(
             metadata_file_name(file_name), total_size, p, k, codec.total_matrix
         )
+        if crcs is not None:
+            append_checksums(metadata_file_name(file_name), crcs)
     written.append(metadata_file_name(file_name))
     return written
 
 
-def _drain_parity(entry, parity_files, timer) -> None:
+def _drain_parity(entry, parity_files, timer, crcs=None, k=0) -> None:
     from . import native
 
     off, cols, parity = entry
     with timer.phase("encode compute"):
         parity_np = np.asarray(parity)  # blocks on device + D2H
+    if crcs is not None:
+        # Segments drain strictly in column order (AsyncWindow is FIFO), so
+        # incremental CRC over each parity row is well-defined.
+        for j in range(parity_np.shape[0]):
+            crcs[k + j] = crc32_of(parity_np[j], crcs.get(k + j, 0))
     with timer.phase("write parity (io)"):
         native.scatter_write(parity_files, parity_np, off)
 
@@ -165,11 +207,17 @@ def decode_file(
     pipeline_depth: int = 2,
     mesh=None,
     stripe_sharded: bool = False,
+    verify_checksums: bool | None = None,
     timer: PhaseTimer | None = None,
 ) -> str:
     """Rebuild ``in_file`` from the k surviving chunks listed in
     ``conf_file``.  Returns the output path (defaults to ``in_file``,
     mirroring the reference's overwrite-input default, decode.cu:410-427).
+
+    ``verify_checksums``: None (default) verifies survivors against the
+    CRC32 extension lines when .METADATA carries them; True requires them;
+    False skips verification.  Raises :class:`ChunkIntegrityError` naming
+    the corrupt chunks so the caller can retry with different survivors.
     """
     timer = timer or PhaseTimer(enabled=False)
     with timer.phase("read metadata (io)"):
@@ -192,6 +240,7 @@ def decode_file(
 
     with timer.phase("open chunks (io)"):
         maps = []
+        paths = []
         for nm in names:
             path = resolve(nm)
             mm = np.memmap(path, dtype=np.uint8, mode="r")
@@ -200,6 +249,39 @@ def decode_file(
                     f"chunk {path!r} is {mm.shape[0]} bytes, expected {chunk}"
                 )
             maps.append(mm)
+            paths.append(path)
+
+    if verify_checksums is not False:
+        crcs = read_checksums(metadata_file_name(in_file))
+        if verify_checksums and not crcs:
+            raise ValueError(
+                f"{metadata_file_name(in_file)!r} has no checksum lines "
+                "but verify_checksums=True"
+            )
+        if crcs:
+            uncovered = [r for r in rows if r not in crcs]
+            if verify_checksums and uncovered:
+                raise ValueError(
+                    f"metadata has no CRC for survivor chunk(s) {uncovered} "
+                    "but verify_checksums=True"
+                )
+            # Verification is a separate pre-pass (reads survivors once more
+            # than strictly needed): corruption is detected BEFORE any device
+            # compute or output writing, and the error names the bad chunks
+            # while the conf can still be fixed.
+            with timer.phase("verify checksums"):
+                step = max(1, segment_bytes)
+                bad = {}
+                for row, mm, path in zip(rows, maps, paths):
+                    if row not in crcs:
+                        continue
+                    crc = 0
+                    for s in range(0, chunk, step):
+                        crc = crc32_of(mm[s : min(s + step, chunk)], crc)
+                    if crc != crcs[row]:
+                        bad[row] = path
+                if bad:
+                    raise ChunkIntegrityError(bad)
 
     codec = RSCodec(
         k, p, strategy=strategy, mesh=mesh, stripe_sharded=stripe_sharded

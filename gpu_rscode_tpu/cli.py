@@ -37,6 +37,8 @@ Extensions: [--generator vandermonde|cauchy]
             [--segment-bytes N] [--quiet] [--profile-dir DIR]
             [--devices N] [--stripe S]  (shard over a device mesh;
             S > 1 additionally shards the stripe/k axis)
+            [--checksum]  (encode: record per-chunk CRC32 in .METADATA)
+            [--no-verify] (decode: skip checksum verification)
 """
 
 
@@ -60,6 +62,8 @@ def main(argv: list[str] | None = None) -> int:
                 "profile-dir=",
                 "devices=",
                 "stripe=",
+                "checksum",
+                "no-verify",
             ],
         )
     except getopt.GetoptError as e:
@@ -78,6 +82,8 @@ def main(argv: list[str] | None = None) -> int:
     profile_dir = None
     n_devices = 0
     stripe = 1
+    checksum = False
+    no_verify = False
 
     for flag, val in opts:
         f = flag.lower()
@@ -119,9 +125,17 @@ def main(argv: list[str] | None = None) -> int:
             n_devices = int(val)
         elif f == "--stripe":
             stripe = int(val)
+        elif f == "--checksum":
+            checksum = True
+        elif f == "--no-verify":
+            no_verify = True
 
     if op is None:
         return _fail("rs: choose encode (-e) or decode (-d)")
+    if checksum and op != "encode":
+        return _fail("rs: --checksum is encode-only (decode verifies automatically)")
+    if no_verify and op != "decode":
+        return _fail("rs: --no-verify is decode-only")
 
     # Import lazily: jax init is slow and -h must be instant.
     from . import api
@@ -159,6 +173,7 @@ def main(argv: list[str] | None = None) -> int:
                 native_num,
                 total_num - native_num,
                 generator=generator,
+                checksums=checksum,
                 timer=timer,
                 **kwargs,
             )
@@ -166,7 +181,11 @@ def main(argv: list[str] | None = None) -> int:
         else:
             if not in_file or not conf_file:
                 return _fail("rs: decoding requires -i and -c")
-            out = api.decode_file(in_file, conf_file, out_file, timer=timer, **kwargs)
+            out = api.decode_file(
+                in_file, conf_file, out_file,
+                verify_checksums=False if no_verify else None,
+                timer=timer, **kwargs,
+            )
             nbytes = os.path.getsize(out)
     except (ValueError, FileNotFoundError, OSError) as e:
         print(f"rs: error: {e}", file=sys.stderr)

@@ -1,0 +1,58 @@
+"""Latency-robust device timing shared by bench.py and the sweep tools.
+
+Under a remote device tunnel (axon) the dispatch+fetch round-trip is tens of
+ms and ``block_until_ready`` is unreliable; these helpers size iteration
+counts so the measured loop dominates the round-trip, force completion with
+a device-side reduction fetched as a scalar, and subtract the measured
+round-trip — falling back to the unsubtracted (conservative) figure when the
+loop did not dominate.
+"""
+
+from __future__ import annotations
+
+import time
+
+
+def rt_latency():
+    """Measured dispatch+fetch round-trip of a trivial op."""
+    import jax
+    import jax.numpy as jnp
+
+    tiny = jax.jit(lambda x: jnp.sum(x))
+    x = jnp.ones((8, 8), jnp.float32)
+    float(tiny(x))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(tiny(x))
+        ts.append(time.perf_counter() - t0)
+    return min(ts)
+
+
+def time_device_fn(fn, trials=2, target_s=1.5):
+    """Per-call seconds of ``fn`` (a thunk returning a device array)."""
+    import jax
+    import jax.numpy as jnp
+
+    reduce_ = jax.jit(lambda x: jnp.sum(x.astype(jnp.int32)))
+    float(reduce_(fn()))  # warmup/compile (incl. the reduction)
+    rt = rt_latency()
+    t0 = time.perf_counter()
+    float(reduce_(fn()))
+    t1 = max(time.perf_counter() - t0 - rt, 1e-4)
+    # Size the loop so the round-trip is noise (<5%), not the signal; the
+    # cap only bounds pathological cases.
+    target = max(target_s, 20.0 * rt)
+    iters = max(1, min(2000, int(target / t1)))
+    best = float("inf")
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            out = fn()
+        float(reduce_(out))
+        total = time.perf_counter() - t0
+        # If the loop didn't dominate the round-trip the subtraction is
+        # unreliable — report the unsubtracted (conservative) figure.
+        per = (total - rt) / iters if total > 4.0 * rt else total / iters
+        best = min(best, per)
+    return best

@@ -1,0 +1,236 @@
+"""Pallas kernel tuning sweep — run on real TPU hardware.
+
+Measures, with the latency-robust timing of bench.py (the axon tunnel adds
+~65 ms RTT):
+
+- per-variant fused-kernel throughput across tile sizes,
+- a DMA-only floor (kernel reads the input block, writes a slice — no
+  compute), and a compute-only ceiling (input index-map pinned to block 0 so
+  the B DMA happens once; full expand+matmul+fold every step),
+
+so the encode kernel's defaults (``pallas_gemm.TPU_TILE`` / ``acc_dtype``)
+stay justified by measurement, the way the reference justified its GF-table
+strategy with the cpu-rs-* series (SURVEY.md C13).
+
+Usage: python -m gpu_rscode_tpu.tools.kernel_sweep [--mb 64] [--trials 2]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+
+from ..models.vandermonde import vandermonde_matrix
+from ..ops.gemm import expand_bitmatrix_jnp
+from .. import native
+from ._bench_timing import time_device_fn as _time
+
+K, P, W = 10, 4, 8
+
+
+# --- kernel bodies ---------------------------------------------------------
+
+def _body_base(a_ref, b_ref, o_ref, *, w, k, p):
+    """Current production body: int32-domain expansion."""
+    b = b_ref[:].astype(jnp.int32)
+    tile = b.shape[-1]
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    planes = ((b[:, None, :] >> shifts) & 1).reshape(k * w, tile)
+    acc = jnp.dot(
+        a_ref[:], planes.astype(jnp.int8), preferred_element_type=jnp.int32
+    )
+    bits = acc & 1
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    o_ref[:] = jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1).astype(
+        o_ref.dtype
+    )
+
+
+def _body_u8(a_ref, b_ref, o_ref, *, w, k, p):
+    """uint8-domain expansion: shifts/ands on 8-bit lanes (4x packing)."""
+    b = b_ref[:]  # uint8
+    tile = b.shape[-1]
+    shifts = jax.lax.broadcasted_iota(jnp.uint8, (1, w, 1), 1)
+    planes = ((b[:, None, :] >> shifts) & jnp.uint8(1)).reshape(k * w, tile)
+    acc = jnp.dot(
+        a_ref[:], planes.astype(jnp.int8), preferred_element_type=jnp.int32
+    )
+    bits = acc & 1
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    o_ref[:] = jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1).astype(
+        o_ref.dtype
+    )
+
+
+def _body_cmp(a_ref, b_ref, o_ref, *, w, k, p):
+    """Mask-compare expansion: (b & 2^s) != 0 — no variable shifts."""
+    b = b_ref[:].astype(jnp.int32)
+    tile = b.shape[-1]
+    masks = jnp.left_shift(
+        1, jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    )
+    planes = ((b[:, None, :] & masks) != 0).reshape(k * w, tile)
+    acc = jnp.dot(
+        a_ref[:], planes.astype(jnp.int8), preferred_element_type=jnp.int32
+    )
+    bits = acc & 1
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    o_ref[:] = jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1).astype(
+        o_ref.dtype
+    )
+
+
+def _body_dma(a_ref, b_ref, o_ref, *, w, k, p):
+    """DMA floor: forces the input block in, minimal compute."""
+    o_ref[:] = b_ref[:p, :]
+
+
+def _expand_sign(b_u8, w, k, tile):
+    """Bit-expand staying in 8-bit lanes: plane s = (int8)(b << (7-s)) >> 7,
+    i.e. {0, -1}.  -1 === 1 (mod 2), so the parity of the int32 matmul
+    accumulator is unchanged; 2 ops/plane on packed int8 lanes."""
+    bts = jax.lax.bitcast_convert_type(b_u8, jnp.int8)
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1).astype(jnp.int8)
+    lsh = jnp.int8(7) - shifts
+    return ((bts[:, None, :] << lsh) >> jnp.int8(7)).reshape(k * w, tile)
+
+
+def _body_sign(a_ref, b_ref, o_ref, *, w, k, p):
+    tile = b_ref.shape[-1]
+    planes = _expand_sign(b_ref[:], w, k, tile)
+    acc = jnp.dot(a_ref[:], planes, preferred_element_type=jnp.int32)
+    bits = acc & 1
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    o_ref[:] = jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1).astype(
+        o_ref.dtype
+    )
+
+
+def _body_signc(a_ref, b_ref, o_ref, *, w, k, p):
+    """Constant-shift unrolled variant of sign (no variable vector shift)."""
+    tile = b_ref.shape[-1]
+    bts = jax.lax.bitcast_convert_type(b_ref[:], jnp.int8)
+    planes = jnp.stack(
+        [(bts << jnp.int8(7 - s)) >> jnp.int8(7) for s in range(w)], axis=1
+    ).reshape(k * w, tile)
+    acc = jnp.dot(a_ref[:], planes, preferred_element_type=jnp.int32)
+    bits = acc & 1
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    o_ref[:] = jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1).astype(
+        o_ref.dtype
+    )
+
+
+def _body_signf(a_ref, b_ref, o_ref, *, w, k, p):
+    """sign expansion + MXU refold: out = F . (acc & 1) with F the (p, p*w)
+    block-diagonal [1,2,...,128] weight — removes the VPU shift/sum fold."""
+    tile = b_ref.shape[-1]
+    planes = _expand_sign(b_ref[:], w, k, tile)
+    acc = jnp.dot(a_ref[:], planes, preferred_element_type=jnp.int32)
+    bits = (acc & 1).astype(jnp.int8)
+    pow2 = (2 ** jnp.arange(w, dtype=jnp.int32)).astype(jnp.float32)
+    fold = jnp.kron(jnp.eye(p, dtype=jnp.float32), pow2.reshape(1, w))
+    out = jnp.dot(fold, bits.astype(jnp.float32), preferred_element_type=jnp.float32)
+    o_ref[:] = out.astype(o_ref.dtype)
+
+
+BODIES = {
+    "base": _body_base,
+    "u8": _body_u8,
+    "cmp": _body_cmp,
+    "dma": _body_dma,
+    "sign": _body_sign,
+    "signc": _body_signc,
+    "signf": _body_signf,
+}
+
+
+def make_fn(name, A_bits, B, tile, pinned_input=False):
+    p, k, w = P, K, W
+    m = B.shape[1]
+    tile = min(tile, m)
+    body = functools.partial(BODIES[name], w=w, k=k, p=p)
+    b_map = (lambda i: (0, 0)) if pinned_input else (lambda i: (0, i))
+
+    @jax.jit
+    def run(A_bits, B):
+        return pl.pallas_call(
+            body,
+            out_shape=jax.ShapeDtypeStruct((p, m), jnp.uint8),
+            grid=(pl.cdiv(m, tile),),
+            in_specs=[
+                pl.BlockSpec((p * w, k * w), lambda i: (0, 0)),
+                pl.BlockSpec((k, tile), b_map),
+            ],
+            out_specs=pl.BlockSpec((p, tile), lambda i: (0, i)),
+        )(A_bits, B)
+
+    return lambda: run(A_bits, B)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=64, help="stripe data MB")
+    ap.add_argument("--trials", type=int, default=2)
+    ap.add_argument(
+        "--tiles", type=str, default="8192,16384,32768,65536"
+    )
+    args = ap.parse_args()
+
+    assert jax.default_backend() == "tpu", "sweep is for real hardware"
+    m = args.mb * 1024 * 1024 // K
+    m = (m // 512) * 512
+    A = vandermonde_matrix(P, K)
+    rng = np.random.default_rng(0)
+    B_host = rng.integers(0, 256, size=(K, m), dtype=np.uint8)
+    A_bits = jax.device_put(
+        np.asarray(expand_bitmatrix_jnp(jnp.asarray(A), W)).astype(np.int8)
+    )
+    Bd = jax.device_put(B_host)
+    oracle = native.gemm(A, B_host[:, :4096])
+    data_bytes = K * m
+
+    tiles = [int(t) for t in args.tiles.split(",")]
+    results = {}
+    for name in ("base", "cmp", "sign", "signc", "signf"):
+        for tile in tiles:
+            fn = make_fn(name, A_bits, Bd, tile)
+            try:
+                got = np.asarray(fn()[:, :4096])
+                if not np.array_equal(got, oracle):
+                    results[f"{name}@{tile}"] = "MISMATCH"
+                    continue
+                dt = _time(fn, trials=args.trials)
+                results[f"{name}@{tile}"] = round(data_bytes / dt / 1e9, 2)
+            except Exception as e:  # noqa: BLE001 — sweep must survive variants
+                results[f"{name}@{tile}"] = f"fail:{type(e).__name__}"
+            print(json.dumps({f"{name}@{tile}": results[f"{name}@{tile}"]}))
+
+    # floors at the best tile so far
+    best_tile = max(
+        (t for t in tiles),
+        key=lambda t: results.get(f"base@{t}", 0)
+        if isinstance(results.get(f"base@{t}"), float)
+        else 0,
+    )
+    for name, pinned in (("dma", False), ("base", True)):
+        key = "dma_floor" if name == "dma" else "compute_only"
+        try:
+            fn = make_fn(name, A_bits, Bd, best_tile, pinned_input=pinned)
+            dt = _time(fn, trials=args.trials)
+            results[key] = round(data_bytes / dt / 1e9, 2)
+        except Exception as e:  # noqa: BLE001
+            results[key] = f"fail:{type(e).__name__}"
+        print(json.dumps({key: results[key]}))
+
+    print(json.dumps({"mb": args.mb, "results": results}))
+
+
+if __name__ == "__main__":
+    main()

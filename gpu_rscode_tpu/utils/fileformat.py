@@ -68,6 +68,53 @@ def read_metadata(path: str) -> tuple[int, int, int, np.ndarray]:
     return total_size, parity_num, native_num, mat
 
 
+def append_checksums(path: str, crcs: dict[int, int]) -> None:
+    """Append per-chunk CRC32 lines to an existing .METADATA file.
+
+    Extension over the reference format (it has no integrity checking —
+    SURVEY §5 "failure detection"): lines ``# crc32 <chunk_index> <8-hex>``
+    AFTER the matrix block.  Backwards/forwards compatible both ways: the
+    reference's parser (decode.cu:257-282) reads a fixed token count and
+    never reaches these lines, and :func:`read_metadata` slices exactly the
+    matrix tokens.
+    """
+    with open(path, "a") as fp:
+        for i in sorted(crcs):
+            fp.write(f"# crc32 {i} {crcs[i] & 0xFFFFFFFF:08x}\n")
+
+
+def read_checksums(path: str) -> dict[int, int]:
+    """Parse ``# crc32`` extension lines from .METADATA ({} if absent).
+
+    Malformed extension lines (bit-rot, foreign comments starting with
+    ``# crc32``) are skipped rather than fatal: a broken checksum LINE must
+    not make decode harder than a broken chunk — the corresponding chunk
+    simply goes unverified.
+    """
+    crcs: dict[int, int] = {}
+    with open(path) as fp:
+        for line in fp:
+            parts = line.split()
+            if (
+                len(parts) == 4
+                and parts[:2] == ["#", "crc32"]
+                and parts[2].isdigit()
+                and len(parts[3]) == 8
+                and all(c in "0123456789abcdefABCDEF" for c in parts[3])
+            ):
+                crcs[int(parts[2])] = int(parts[3], 16)
+    return crcs
+
+
+def crc32_of(buf, crc: int = 0) -> int:
+    """Incremental CRC32 (zlib polynomial) over bytes-like / ndarray data."""
+    import zlib
+
+    if isinstance(buf, (bytes, bytearray, memoryview)):
+        return zlib.crc32(buf, crc)  # no copy; also correct for b""
+    return zlib.crc32(memoryview(np.ascontiguousarray(buf)).cast("B"), crc)
+
+
 def parse_chunk_index(name: str) -> int:
     """Row index from a chunk file name: integer digits right after the first
     character (reference semantics: ``atoi(name + 1)``)."""

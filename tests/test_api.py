@@ -174,3 +174,77 @@ def test_partial_recovery_all_parity_survivors(tmp_path):
     out = str(tmp_path / "o")
     api.decode_file(path, conf, out)
     assert open(out, "rb").read() == orig
+
+
+# ----- checksum extension ---------------------------------------------------
+
+
+def test_checksum_roundtrip_and_verify(tmp_path):
+    """CRC32 extension lines are written, parsed back, and verified clean on
+    decode; the metadata stays parseable as the base (reference) format."""
+    from gpu_rscode_tpu.utils.fileformat import (
+        metadata_file_name,
+        read_checksums,
+        read_metadata,
+    )
+
+    path = _mkfile(tmp_path, 12_345, seed=21)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2, checksums=True)
+    meta = metadata_file_name(path)
+    crcs = read_checksums(meta)
+    assert sorted(crcs) == list(range(6))  # one CRC per chunk, natives+parity
+    # Base-format parse is unaffected by the trailing extension lines.
+    total_size, p, k, mat = read_metadata(meta)
+    assert (total_size, p, k) == (12_345, 2, 4)
+    conf = make_conf(6, 4, path)
+    out = str(tmp_path / "out.bin")
+    api.decode_file(path, conf, out)  # auto-verify, must pass
+    assert open(out, "rb").read() == orig
+
+
+def test_checksum_detects_corrupt_survivor(tmp_path):
+    path = _mkfile(tmp_path, 20_000, seed=22)
+    api.encode_file(path, 4, 2, checksums=True)
+    conf = make_conf(6, 4, path)  # survivors 2..5
+    victim = chunk_file_name(path, 3)
+    data = bytearray(open(victim, "rb").read())
+    data[100] ^= 0xFF
+    open(victim, "wb").write(bytes(data))
+    with pytest.raises(api.ChunkIntegrityError) as ei:
+        api.decode_file(path, conf, str(tmp_path / "o"))
+    assert 3 in ei.value.bad_chunks
+    # Skipping verification decodes the corrupt bytes without complaint.
+    api.decode_file(path, conf, str(tmp_path / "o2"), verify_checksums=False)
+
+
+def test_checksum_absent_is_not_verified(tmp_path):
+    """Default encode writes no checksums; decode must not require them,
+    and verify_checksums=True must then fail fast."""
+    path = _mkfile(tmp_path, 5_000, seed=23)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2)
+    conf = make_conf(6, 4, path)
+    out = str(tmp_path / "o")
+    api.decode_file(path, conf, out)
+    assert open(out, "rb").read() == orig
+    with pytest.raises(ValueError, match="no checksum"):
+        api.decode_file(path, conf, out, verify_checksums=True)
+
+
+def test_checksum_segmented_encode_consistent(tmp_path):
+    """CRCs accumulated across multiple streamed segments equal whole-file
+    CRCs (FIFO drain order contract)."""
+    import zlib
+
+    from gpu_rscode_tpu.utils.fileformat import (
+        metadata_file_name,
+        read_checksums,
+    )
+
+    path = _mkfile(tmp_path, 50_000, seed=24)
+    api.encode_file(path, 4, 2, checksums=True, segment_bytes=4096)
+    crcs = read_checksums(metadata_file_name(path))
+    for i in range(6):
+        whole = zlib.crc32(open(chunk_file_name(path, i), "rb").read())
+        assert crcs[i] == whole, f"chunk {i}"
