@@ -34,9 +34,8 @@ from .utils.fileformat import (
     crc32_of,
     metadata_file_name,
     parse_chunk_index,
-    read_checksums,
     read_conf,
-    read_metadata,
+    read_metadata_ext,
     write_metadata,
 )
 from .utils.timing import PhaseTimer
@@ -85,6 +84,7 @@ def encode_file(
     mesh=None,
     stripe_sharded: bool = False,
     checksums: bool = False,
+    w: int = 8,
     timer: PhaseTimer | None = None,
 ) -> list[str]:
     """Encode ``file_name`` into n = k + p chunk files plus .METADATA.
@@ -95,17 +95,24 @@ def encode_file(
     ``checksums=True`` appends per-chunk CRC32 lines to .METADATA (format
     extension; decode verifies them automatically when present).  Off by
     default so the metadata stays byte-identical to the reference's.
+    ``w``: symbol width — 8 (reference-compatible) or 16 (wide-symbol
+    extension: chunks hold little-endian uint16 symbols, recorded in
+    .METADATA as ``# gfwidth 16``; supports up to 65536 total chunks where
+    GF(2^8) caps out at 256).
     """
     timer = timer or PhaseTimer(enabled=False)
+    if w not in (8, 16):
+        raise ValueError(f"file-layer symbol width must be 8 or 16, got {w}")
+    sym = w // 8
     k, p = native_num, parity_num
     codec = RSCodec(
-        k, p, generator=generator, strategy=strategy,
+        k, p, w=w, generator=generator, strategy=strategy,
         mesh=mesh, stripe_sharded=stripe_sharded,
     )
     total_size = os.path.getsize(file_name)
     if total_size == 0:
         raise ValueError(f"refusing to encode empty file {file_name!r}")
-    chunk = chunk_size_for(total_size, k)
+    chunk = chunk_size_for(total_size, k, sym)
     seg_cols = _segment_cols(chunk, k, segment_bytes)
 
     src = np.memmap(file_name, dtype=np.uint8, mode="r")
@@ -164,6 +171,8 @@ def encode_file(
                 cols = min(seg_cols, chunk - off)
                 with timer.phase("stage segment (io)"):
                     host_seg = gather_segment(off, cols)
+                if sym > 1:  # reinterpret bytes as little-endian symbols
+                    host_seg = host_seg.view(np.uint16)
                 with timer.phase("encode dispatch"):
                     parity = codec.encode(host_seg)  # async
                 window.push((off, cols), parity)
@@ -174,7 +183,7 @@ def encode_file(
 
     with timer.phase("write metadata (io)"):
         write_metadata(
-            metadata_file_name(file_name), total_size, p, k, codec.total_matrix
+            metadata_file_name(file_name), total_size, p, k, codec.total_matrix, w=w
         )
         if crcs is not None:
             append_checksums(metadata_file_name(file_name), crcs)
@@ -188,6 +197,8 @@ def _drain_parity(entry, parity_files, timer, crcs=None, k=0) -> None:
     off, cols, parity = entry
     with timer.phase("encode compute"):
         parity_np = np.asarray(parity)  # blocks on device + D2H
+    if parity_np.dtype != np.uint8:
+        parity_np = np.ascontiguousarray(parity_np).view(np.uint8)  # LE symbol bytes
     if crcs is not None:
         # Segments drain strictly in column order (AsyncWindow is FIFO), so
         # incremental CRC over each parity row is well-defined.
@@ -221,8 +232,16 @@ def decode_file(
     """
     timer = timer or PhaseTimer(enabled=False)
     with timer.phase("read metadata (io)"):
-        total_size, p, k, total_mat = read_metadata(metadata_file_name(in_file))
-    chunk = chunk_size_for(total_size, k)
+        total_size, p, k, total_mat, w, crcs = read_metadata_ext(
+            metadata_file_name(in_file)
+        )
+    if w not in (8, 16):
+        raise ValueError(
+            f"unsupported gfwidth {w} in {metadata_file_name(in_file)!r} "
+            "(this build decodes w=8 and w=16 files)"
+        )
+    sym = w // 8
+    chunk = chunk_size_for(total_size, k, sym)
     names = read_conf(conf_file)
     if len(names) != k:
         raise ValueError(f"conf file lists {len(names)} chunks, need k={k}")
@@ -252,7 +271,6 @@ def decode_file(
             paths.append(path)
 
     if verify_checksums is not False:
-        crcs = read_checksums(metadata_file_name(in_file))
         if verify_checksums and not crcs:
             raise ValueError(
                 f"{metadata_file_name(in_file)!r} has no checksum lines "
@@ -284,8 +302,9 @@ def decode_file(
                     raise ChunkIntegrityError(bad)
 
     codec = RSCodec(
-        k, p, strategy=strategy, mesh=mesh, stripe_sharded=stripe_sharded
+        k, p, w=w, strategy=strategy, mesh=mesh, stripe_sharded=stripe_sharded
     )
+    total_mat = total_mat.astype(codec.gf.dtype)
     with timer.phase("invert matrix"):
         dec_mat = codec.decode_matrix_from(total_mat, rows)
 
@@ -323,6 +342,8 @@ def decode_file(
             off, cols = tag
             with timer.phase("decode compute"):
                 rec_np = np.asarray(rec) if rec is not None else None
+            if rec_np is not None and rec_np.dtype != np.uint8:
+                rec_np = np.ascontiguousarray(rec_np).view(np.uint8)  # LE bytes
             with timer.phase("write output (io)"):
                 for i in range(k):
                     if i in native_pos:
@@ -338,6 +359,8 @@ def decode_file(
                 if dec_missing is not None:
                     with timer.phase("stage segment (io)"):
                         seg = np.stack([mm[off : off + cols] for mm in maps])
+                    if sym > 1:
+                        seg = seg.view(np.uint16)
                     with timer.phase("decode dispatch"):
                         rec = codec.decode(dec_missing, seg)  # async
                 else:

@@ -39,6 +39,8 @@ Extensions: [--generator vandermonde|cauchy]
             S > 1 additionally shards the stripe/k axis)
             [--checksum]  (encode: record per-chunk CRC32 in .METADATA)
             [--no-verify] (decode: skip checksum verification)
+            [--width 8|16] (encode: GF symbol width; 16 = wide-symbol
+            extension recorded in .METADATA, decode auto-detects)
 """
 
 
@@ -64,6 +66,7 @@ def main(argv: list[str] | None = None) -> int:
                 "stripe=",
                 "checksum",
                 "no-verify",
+                "width=",
             ],
         )
     except getopt.GetoptError as e:
@@ -84,6 +87,7 @@ def main(argv: list[str] | None = None) -> int:
     stripe = 1
     checksum = False
     no_verify = False
+    width = 8
 
     for flag, val in opts:
         f = flag.lower()
@@ -129,6 +133,8 @@ def main(argv: list[str] | None = None) -> int:
             checksum = True
         elif f == "--no-verify":
             no_verify = True
+        elif f == "--width":
+            width = int(val)
 
     if op is None:
         return _fail("rs: choose encode (-e) or decode (-d)")
@@ -136,6 +142,10 @@ def main(argv: list[str] | None = None) -> int:
         return _fail("rs: --checksum is encode-only (decode verifies automatically)")
     if no_verify and op != "decode":
         return _fail("rs: --no-verify is decode-only")
+    if width != 8 and op != "encode":
+        return _fail("rs: --width is encode-only (decode reads it from .METADATA)")
+    if width not in (8, 16):
+        return _fail(f"rs: --width must be 8 or 16, got {width}")
 
     # Import lazily: jax init is slow and -h must be instant.
     from . import api
@@ -174,6 +184,7 @@ def main(argv: list[str] | None = None) -> int:
                 total_num - native_num,
                 generator=generator,
                 checksums=checksum,
+                w=width,
                 timer=timer,
                 **kwargs,
             )

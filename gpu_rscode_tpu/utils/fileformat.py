@@ -35,11 +35,22 @@ def metadata_file_name(file_name: str) -> str:
     return file_name + ".METADATA"
 
 
-def chunk_size_for(total_size: int, native_num: int) -> int:
-    return -(-total_size // native_num)  # ceil
+def chunk_size_for(total_size: int, native_num: int, sym: int = 1) -> int:
+    """Bytes per chunk: ceil(total/k), rounded up to the symbol size
+    (``sym`` = 2 for GF(2^16) file coding so every chunk holds whole
+    symbols; 1 = reference-compatible GF(2^8) layout)."""
+    chunk = -(-total_size // native_num)  # ceil
+    return -(-chunk // sym) * sym
 
 
-def write_metadata(path: str, total_size: int, parity_num: int, native_num: int, total_mat: np.ndarray) -> None:
+def write_metadata(
+    path: str,
+    total_size: int,
+    parity_num: int,
+    native_num: int,
+    total_mat: np.ndarray,
+    w: int = 8,
+) -> None:
     rows = native_num + parity_num
     assert total_mat.shape == (rows, native_num), total_mat.shape
     with open(path, "w") as fp:
@@ -47,12 +58,54 @@ def write_metadata(path: str, total_size: int, parity_num: int, native_num: int,
         fp.write(f"{parity_num} {native_num}\n")
         for i in range(rows):
             fp.write("".join(f"{int(v)} " for v in total_mat[i]) + "\n")
+        if w != 8:
+            # Wide-symbol extension line (same trailing-comment scheme as the
+            # CRC32 lines: invisible to the fixed-token reference parser).
+            fp.write(f"# gfwidth {w}\n")
+
+
+def _parse_field_width(text: str) -> int:
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[:2] == ["#", "gfwidth"] and parts[2].isdigit():
+            return int(parts[2])
+    return 8
+
+
+def read_field_width(path: str) -> int:
+    """GF width of a metadata file: the ``# gfwidth`` extension line, or 8
+    (the reference's only width) when absent."""
+    with open(path) as fp:
+        return _parse_field_width(fp.read())
+
+
+def read_metadata_ext(path: str):
+    """One-read parse of .METADATA including extension lines.
+
+    Returns ``(total_size, parity_num, native_num, total_matrix, w, crcs)``
+    — the base-format fields plus the ``# gfwidth`` width (8 when absent)
+    and the ``# crc32`` checksum dict ({} when absent)."""
+    with open(path) as fp:
+        text = fp.read()
+    total_size, parity_num, native_num, mat = _parse_metadata(text, path)
+    return (
+        total_size,
+        parity_num,
+        native_num,
+        mat,
+        _parse_field_width(text),
+        _parse_checksums(text),
+    )
 
 
 def read_metadata(path: str) -> tuple[int, int, int, np.ndarray]:
     """Returns (total_size, parity_num, native_num, total_matrix)."""
     with open(path) as fp:
-        tokens = fp.read().split()
+        return _parse_metadata(fp.read(), path)
+
+
+def _parse_metadata(text: str, path: str) -> tuple[int, int, int, np.ndarray]:
+    tokens = text.split()
     if len(tokens) < 3:
         raise ValueError(f"malformed metadata file {path!r}")
     total_size, parity_num, native_num = int(tokens[0]), int(tokens[1]), int(tokens[2])
@@ -62,7 +115,11 @@ def read_metadata(path: str) -> tuple[int, int, int, np.ndarray]:
         raise ValueError(
             f"metadata matrix truncated: expected {want} entries, got {len(mat_tokens)}"
         )
-    mat = np.array([int(t) for t in mat_tokens], dtype=np.uint8).reshape(
+    vals = [int(t) for t in mat_tokens]
+    # uint16 when any entry exceeds a byte (GF(2^16) extension metadata);
+    # the reference's GF(2^8) files always fit uint8.
+    dtype = np.uint16 if max(vals) > 255 else np.uint8
+    mat = np.array(vals, dtype=dtype).reshape(
         native_num + parity_num, native_num
     )
     return total_size, parity_num, native_num, mat
@@ -83,6 +140,21 @@ def append_checksums(path: str, crcs: dict[int, int]) -> None:
             fp.write(f"# crc32 {i} {crcs[i] & 0xFFFFFFFF:08x}\n")
 
 
+def _parse_checksums(text: str) -> dict[int, int]:
+    crcs: dict[int, int] = {}
+    for line in text.splitlines():
+        parts = line.split()
+        if (
+            len(parts) == 4
+            and parts[:2] == ["#", "crc32"]
+            and parts[2].isdigit()
+            and len(parts[3]) == 8
+            and all(c in "0123456789abcdefABCDEF" for c in parts[3])
+        ):
+            crcs[int(parts[2])] = int(parts[3], 16)
+    return crcs
+
+
 def read_checksums(path: str) -> dict[int, int]:
     """Parse ``# crc32`` extension lines from .METADATA ({} if absent).
 
@@ -91,19 +163,8 @@ def read_checksums(path: str) -> dict[int, int]:
     not make decode harder than a broken chunk — the corresponding chunk
     simply goes unverified.
     """
-    crcs: dict[int, int] = {}
     with open(path) as fp:
-        for line in fp:
-            parts = line.split()
-            if (
-                len(parts) == 4
-                and parts[:2] == ["#", "crc32"]
-                and parts[2].isdigit()
-                and len(parts[3]) == 8
-                and all(c in "0123456789abcdefABCDEF" for c in parts[3])
-            ):
-                crcs[int(parts[2])] = int(parts[3], 16)
-    return crcs
+        return _parse_checksums(fp.read())
 
 
 def crc32_of(buf, crc: int = 0) -> int:

@@ -248,3 +248,95 @@ def test_checksum_segmented_encode_consistent(tmp_path):
     for i in range(6):
         whole = zlib.crc32(open(chunk_file_name(path, i), "rb").read())
         assert crcs[i] == whole, f"chunk {i}"
+
+
+# ----- wide-symbol (GF(2^16)) file coding -----------------------------------
+
+
+@pytest.mark.parametrize("size", [10_000, 10_001, 9_999])
+def test_wide_symbol_roundtrip_worst_case(tmp_path, size):
+    """w=16 file coding: chunks hold LE uint16 symbols, chunk size is
+    2-aligned, .METADATA records gfwidth, decode auto-detects and recovers
+    bit-exactly under the worst-case erasure (incl. odd file sizes)."""
+    from gpu_rscode_tpu.utils.fileformat import (
+        metadata_file_name,
+        read_field_width,
+    )
+
+    path = _mkfile(tmp_path, size, seed=size + 1)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2, w=16)
+    assert read_field_width(metadata_file_name(path)) == 16
+    assert os.path.getsize(chunk_file_name(path, 0)) % 2 == 0
+    conf = make_conf(6, 4, path)
+    out = str(tmp_path / "out.bin")
+    api.decode_file(path, conf, out)
+    assert open(out, "rb").read() == orig
+
+
+def test_wide_symbol_metadata_matrix_parses(tmp_path):
+    """Wide metadata carries entries > 255 and parses back as uint16."""
+    from gpu_rscode_tpu.utils.fileformat import (
+        metadata_file_name,
+        read_metadata,
+    )
+
+    path = _mkfile(tmp_path, 4_096, seed=31)
+    api.encode_file(path, 8, 4, w=16)
+    _, p, k, mat = read_metadata(metadata_file_name(path))
+    assert (p, k) == (4, 8)
+    assert mat.dtype == np.uint16
+    assert mat.max() > 255  # (j+1)^i over GF(2^16) exceeds a byte at k=8,p=4
+
+
+def test_wide_symbol_with_checksums(tmp_path):
+    """Both metadata extensions coexist."""
+    from gpu_rscode_tpu.utils.fileformat import (
+        metadata_file_name,
+        read_checksums,
+        read_field_width,
+    )
+
+    path = _mkfile(tmp_path, 7_777, seed=32)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2, w=16, checksums=True)
+    meta = metadata_file_name(path)
+    assert read_field_width(meta) == 16
+    assert sorted(read_checksums(meta)) == list(range(6))
+    conf = make_conf(6, 4, path)
+    out = str(tmp_path / "o")
+    api.decode_file(path, conf, out)
+    assert open(out, "rb").read() == orig
+
+
+def test_default_width_unchanged(tmp_path):
+    """w=8 metadata must carry NO gfwidth line (byte-compat preserved)."""
+    from gpu_rscode_tpu.utils.fileformat import (
+        metadata_file_name,
+        read_field_width,
+    )
+
+    path = _mkfile(tmp_path, 1_000, seed=33)
+    api.encode_file(path, 4, 2)
+    assert read_field_width(metadata_file_name(path)) == 8
+    assert "gfwidth" not in open(metadata_file_name(path)).read()
+
+
+def test_bad_width_rejected(tmp_path):
+    path = _mkfile(tmp_path, 100, seed=34)
+    with pytest.raises(ValueError, match="width"):
+        api.encode_file(path, 2, 1, w=4)
+
+
+def test_decode_rejects_unknown_gfwidth(tmp_path):
+    """A foreign/corrupt '# gfwidth' value must fail with a clean error,
+    not a crash (file-supplied input)."""
+    from gpu_rscode_tpu.utils.fileformat import metadata_file_name
+
+    path = _mkfile(tmp_path, 2_000, seed=35)
+    api.encode_file(path, 4, 2)
+    with open(metadata_file_name(path), "a") as fp:
+        fp.write("# gfwidth 4\n")
+    conf = make_conf(6, 4, path)
+    with pytest.raises(ValueError, match="gfwidth"):
+        api.decode_file(path, conf, str(tmp_path / "o"))
