@@ -29,6 +29,7 @@ from .codec import RSCodec
 from .parallel.pipeline import AsyncWindow
 from .utils.fileformat import (
     append_checksums,
+    chunk_crc32,
     chunk_file_name,
     chunk_size_for,
     crc32_of,
@@ -36,6 +37,7 @@ from .utils.fileformat import (
     parse_chunk_index,
     read_conf,
     read_metadata_ext,
+    write_conf,
     write_metadata,
 )
 from .utils.timing import PhaseTimer
@@ -240,6 +242,11 @@ def decode_file(
             f"unsupported gfwidth {w} in {metadata_file_name(in_file)!r} "
             "(this build decodes w=8 and w=16 files)"
         )
+    if int(total_mat.max(initial=0)) >= (1 << w):
+        raise ValueError(
+            f"metadata matrix entry {int(total_mat.max())} out of range for "
+            f"GF(2^{w}) — corrupt or foreign .METADATA"
+        )
     sym = w // 8
     chunk = chunk_size_for(total_size, k, sym)
     names = read_conf(conf_file)
@@ -288,15 +295,11 @@ def decode_file(
             # compute or output writing, and the error names the bad chunks
             # while the conf can still be fixed.
             with timer.phase("verify checksums"):
-                step = max(1, segment_bytes)
                 bad = {}
                 for row, mm, path in zip(rows, maps, paths):
                     if row not in crcs:
                         continue
-                    crc = 0
-                    for s in range(0, chunk, step):
-                        crc = crc32_of(mm[s : min(s + step, chunk)], crc)
-                    if crc != crcs[row]:
+                    if chunk_crc32(mm, chunk, segment_bytes) != crcs[row]:
                         bad[row] = path
                 if bad:
                     raise ChunkIntegrityError(bad)
@@ -370,3 +373,102 @@ def decode_file(
         out_fp.truncate(total_size)
     os.replace(tmp_path, out_path)
     return out_path
+
+
+def auto_decode_file(
+    in_file: str,
+    output: str | None = None,
+    *,
+    conf_out: str | None = None,
+    **decode_kwargs,
+) -> str:
+    """Decode without a hand-written conf: discover surviving chunks, drop
+    corrupt ones, pick a decodable k-subset, and rebuild the file.
+
+    The reference has no equivalent — its conf file is the (manual) fault
+    model (unit-test.sh, SURVEY §4).  This automates the full self-healing
+    flow the CRC32 extension enables:
+
+    1. scan for ``_<i>_<name>`` chunk files next to ``in_file``;
+    2. discard wrong-sized chunks, and (when .METADATA carries CRC lines)
+       chunks whose bytes fail their checksum;
+    3. choose k survivors, natives first (cheapest: partial recovery copies
+       them through), falling back to other subsets if the selected
+       submatrix is singular;
+    4. write the chosen survivor list as a conf file (``conf_out``, default
+       ``<in_file>.auto.conf`` — an auditable artifact in the reference's
+       own format) and run :func:`decode_file` with it.
+
+    Raises ValueError when fewer than k healthy chunks remain or no
+    decodable subset exists.  ``decode_kwargs`` pass through to decode_file.
+    """
+    from itertools import combinations
+
+    from .ops.inverse import SingularMatrixError
+
+    meta = metadata_file_name(in_file)
+    total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
+    if w in (8, 16) and int(total_mat.max(initial=0)) >= (1 << w):
+        raise ValueError(
+            f"metadata matrix entry {int(total_mat.max())} out of range for "
+            f"GF(2^{w}) — corrupt or foreign .METADATA"
+        )
+    sym = w // 8 if w in (8, 16) else 1
+    chunk = chunk_size_for(total_size, k, sym)
+
+    healthy: list[int] = []
+    bad: dict[int, str] = {}
+    for i in range(k + p):
+        path = chunk_file_name(in_file, i)
+        if not os.path.exists(path) or os.path.getsize(path) < chunk:
+            continue
+        if i in crcs:
+            mm = np.memmap(path, dtype=np.uint8, mode="r")
+            step = decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
+            if chunk_crc32(mm, chunk, step) != crcs[i]:
+                bad[i] = path
+                continue
+        healthy.append(i)
+
+    if len(healthy) < k:
+        raise ValueError(
+            f"only {len(healthy)} healthy chunks of the k={k} needed "
+            f"(corrupt: {sorted(bad)}, missing: "
+            f"{sorted(set(range(k + p)) - set(healthy) - set(bad))})"
+        )
+
+    # Natives-first candidate order (partial recovery makes them free), then
+    # parity; lazily fall back through other subsets on singularity.  The cap
+    # bounds pathological non-MDS matrices; Vandermonde/Cauchy submatrices
+    # are near-always invertible so the first try is the common case.
+    from .ops.inverse import invert_matrix
+    from .ops.gf import get_field
+
+    gf = get_field(w)
+    mat = total_mat.astype(gf.dtype)
+    chosen = None
+    for attempt, subset in enumerate(combinations(healthy, k)):
+        if attempt >= 100:
+            break
+        try:
+            invert_matrix(mat[list(subset)], gf)
+            chosen = list(subset)
+            break
+        except SingularMatrixError:
+            continue
+    if chosen is None:
+        raise ValueError(
+            f"no decodable k={k} subset among healthy chunks {healthy}"
+        )
+
+    conf_path = conf_out or (in_file + ".auto.conf")
+    write_conf(
+        conf_path,
+        [os.path.basename(chunk_file_name(in_file, i)) for i in chosen],
+    )
+    # The scan above already CRC-verified exactly the chunks it selected —
+    # don't pay a second full read in decode_file unless the caller
+    # explicitly demanded verification.
+    if decode_kwargs.get("verify_checksums") is None:
+        decode_kwargs["verify_checksums"] = False
+    return decode_file(in_file, conf_path, output, **decode_kwargs)

@@ -41,6 +41,8 @@ Extensions: [--generator vandermonde|cauchy]
             [--no-verify] (decode: skip checksum verification)
             [--width 8|16] (encode: GF symbol width; 16 = wide-symbol
             extension recorded in .METADATA, decode auto-detects)
+            [--auto] (decode without -c: discover healthy chunks, skip
+            corrupt ones via CRC32, pick a decodable subset)
 """
 
 
@@ -67,6 +69,7 @@ def main(argv: list[str] | None = None) -> int:
                 "checksum",
                 "no-verify",
                 "width=",
+                "auto",
             ],
         )
     except getopt.GetoptError as e:
@@ -88,6 +91,7 @@ def main(argv: list[str] | None = None) -> int:
     checksum = False
     no_verify = False
     width = 8
+    auto = False
 
     for flag, val in opts:
         f = flag.lower()
@@ -135,6 +139,8 @@ def main(argv: list[str] | None = None) -> int:
             no_verify = True
         elif f == "--width":
             width = int(val)
+        elif f == "--auto":
+            auto = True
 
     if op is None:
         return _fail("rs: choose encode (-e) or decode (-d)")
@@ -146,6 +152,10 @@ def main(argv: list[str] | None = None) -> int:
         return _fail("rs: --width is encode-only (decode reads it from .METADATA)")
     if width not in (8, 16):
         return _fail(f"rs: --width must be 8 or 16, got {width}")
+    if auto and op != "decode":
+        return _fail("rs: --auto is decode-only")
+    if auto and conf_file:
+        return _fail("rs: -c and --auto conflict; pick one survivor source")
 
     # Import lazily: jax init is slow and -h must be instant.
     from . import api
@@ -190,13 +200,20 @@ def main(argv: list[str] | None = None) -> int:
             )
             nbytes = os.path.getsize(in_file)
         else:
-            if not in_file or not conf_file:
-                return _fail("rs: decoding requires -i and -c")
-            out = api.decode_file(
-                in_file, conf_file, out_file,
-                verify_checksums=False if no_verify else None,
-                timer=timer, **kwargs,
-            )
+            if not in_file or (not conf_file and not auto):
+                return _fail("rs: decoding requires -i and -c (or --auto)")
+            if auto:
+                out = api.auto_decode_file(
+                    in_file, out_file,
+                    verify_checksums=False if no_verify else None,
+                    timer=timer, **kwargs,
+                )
+            else:
+                out = api.decode_file(
+                    in_file, conf_file, out_file,
+                    verify_checksums=False if no_verify else None,
+                    timer=timer, **kwargs,
+                )
             nbytes = os.path.getsize(out)
     except (ValueError, FileNotFoundError, OSError) as e:
         print(f"rs: error: {e}", file=sys.stderr)

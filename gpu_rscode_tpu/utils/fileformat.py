@@ -167,6 +167,17 @@ def read_checksums(path: str) -> dict[int, int]:
         return _parse_checksums(fp.read())
 
 
+def chunk_crc32(mm, chunk: int, step: int) -> int:
+    """CRC32 of ``mm[:chunk]`` computed in bounded ``step``-byte slices (the
+    single definition of per-chunk checksum semantics: whole chunk,
+    padding included)."""
+    crc = 0
+    step = max(1, step)
+    for s in range(0, chunk, step):
+        crc = crc32_of(mm[s : min(s + step, chunk)], crc)
+    return crc
+
+
 def crc32_of(buf, crc: int = 0) -> int:
     """Incremental CRC32 (zlib polynomial) over bytes-like / ndarray data."""
     import zlib

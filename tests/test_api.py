@@ -340,3 +340,65 @@ def test_decode_rejects_unknown_gfwidth(tmp_path):
     conf = make_conf(6, 4, path)
     with pytest.raises(ValueError, match="gfwidth"):
         api.decode_file(path, conf, str(tmp_path / "o"))
+
+
+# ----- auto-decode (survivor auto-discovery) --------------------------------
+
+
+def test_auto_decode_skips_corrupt_and_missing(tmp_path):
+    """Self-healing flow: one chunk deleted, one corrupted — auto-decode
+    must detect both via CRC, pick healthy survivors, and recover."""
+    path = _mkfile(tmp_path, 33_333, seed=41)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 3, checksums=True)
+    os.remove(chunk_file_name(path, 1))  # native lost
+    victim = chunk_file_name(path, 2)  # native corrupted
+    data = bytearray(open(victim, "rb").read())
+    data[7] ^= 0x55
+    open(victim, "wb").write(bytes(data))
+    out = str(tmp_path / "o")
+    got = api.auto_decode_file(path, out)
+    assert got == out
+    assert open(out, "rb").read() == orig
+    # The chosen conf is written as an auditable artifact.
+    conf = open(path + ".auto.conf").read().split()
+    assert len(conf) == 4
+    assert not any(nm.startswith("_1_") or nm.startswith("_2_") for nm in conf)
+
+
+def test_auto_decode_without_checksums(tmp_path):
+    """Without CRC lines, auto-decode still handles missing chunks (it just
+    cannot detect silent corruption)."""
+    path = _mkfile(tmp_path, 10_000, seed=42)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2)
+    os.remove(chunk_file_name(path, 0))
+    os.remove(chunk_file_name(path, 3))
+    out = str(tmp_path / "o")
+    api.auto_decode_file(path, out)
+    assert open(out, "rb").read() == orig
+
+
+def test_auto_decode_too_few_survivors(tmp_path):
+    path = _mkfile(tmp_path, 5_000, seed=43)
+    api.encode_file(path, 4, 2)
+    for i in (0, 1, 2):
+        os.remove(chunk_file_name(path, i))
+    with pytest.raises(ValueError, match="healthy"):
+        api.auto_decode_file(path, str(tmp_path / "o"))
+
+
+def test_decode_rejects_out_of_range_matrix_entry(tmp_path):
+    """A w=8 metadata whose matrix carries an entry > 255 must be rejected,
+    not silently wrapped into GF(2^8)."""
+    from gpu_rscode_tpu.utils.fileformat import metadata_file_name
+
+    path = _mkfile(tmp_path, 2_000, seed=36)
+    api.encode_file(path, 4, 2)
+    meta = metadata_file_name(path)
+    lines = open(meta).read().splitlines()
+    lines[2] = lines[2].replace(lines[2].split()[0], "300", 1)
+    open(meta, "w").write("\n".join(lines) + "\n")
+    conf = make_conf(6, 4, path)
+    with pytest.raises(ValueError, match="out of range"):
+        api.decode_file(path, conf, str(tmp_path / "o"))
