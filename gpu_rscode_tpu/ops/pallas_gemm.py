@@ -24,6 +24,16 @@ Grid: 1-D over column tiles, the embarrassingly-parallel axis (the
 reference's grid-stride column sweep, matrix.cu:265-322).  Out-of-range
 columns in the last tile compute garbage on garbage and are dropped by the
 masked output write Pallas performs automatically.
+
+Two bit-expansion formulations (``expand``), both bit-verified; the 2026-07
+v5e sweep (tools/kernel_sweep.py) showed the kernel is compute-bound on the
+expansion (DMA floor ~268 GB/s vs ~63 GB/s end-to-end), motivating "sign":
+
+* ``"shift"`` — plane s = (b >> s) & 1 in int32 lanes (proven default).
+* ``"sign"``  — plane s = (int_w)(b << (w-1-s)) >> (w-1), i.e. {0, -1},
+  staying in w-bit lanes (4x VPU packing for w=8).  -1 === 1 (mod 2), so
+  the parity of the integer accumulator — all the refold reads — is
+  unchanged.
 """
 
 from __future__ import annotations
@@ -42,17 +52,33 @@ DEFAULT_TILE = 2048      # interpret / CPU-mesh default
 TPU_TILE = 16384         # measured best on v5e (.sweep: 61.7 GB/s vs 42 @ 2048)
 
 
-def _kernel(a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype):
-    b = b_ref[:].astype(jnp.int32)  # (k, TILE)
-    tile = b.shape[-1]
+def _expand_shift(b, w, k, tile):
+    b = b.astype(jnp.int32)
     in_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
-    planes = ((b[:, None, :] >> in_shifts) & 1).reshape(k * w, tile)
+    return ((b[:, None, :] >> in_shifts) & 1).reshape(k * w, tile)
+
+
+def _expand_sign(b, w, k, tile):
+    sdt = jnp.int8 if w == 8 else jnp.int16
+    bts = jax.lax.bitcast_convert_type(b, sdt)
+    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1).astype(sdt)
+    lsh = sdt(w - 1) - shifts
+    return ((bts[:, None, :] << lsh) >> sdt(w - 1)).reshape(k * w, tile)
+
+
+def _kernel(a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand):
+    tile = b_ref.shape[-1]
+    expander = _expand_sign if expand == "sign" else _expand_shift
+    planes = expander(b_ref[:], w, k, tile)
     acc = jnp.dot(
         a_ref[:].astype(acc_dtype),
         planes.astype(acc_dtype),
         preferred_element_type=jnp.float32 if acc_dtype != jnp.int8 else jnp.int32,
     )
-    bits = acc.astype(jnp.int32) & 1  # parity: XOR == sum mod 2
+    # Parity: XOR == sum mod 2.  Holds for the sign formulation too:
+    # two's-complement (-n) & 1 == n & 1, and f32->int32 truncation is exact
+    # for these small integers.
+    bits = acc.astype(jnp.int32) & 1
     out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
     o_ref[:] = (
         jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1)
@@ -61,9 +87,9 @@ def _kernel(a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("w", "tile", "acc_dtype", "interpret")
+    jax.jit, static_argnames=("w", "tile", "acc_dtype", "interpret", "expand")
 )
-def _pallas_matmul(A, B, w, tile, acc_dtype, interpret):
+def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand):
     gf = get_field(w)
     p, k = A.shape
     _, m = B.shape
@@ -80,7 +106,9 @@ def _pallas_matmul(A, B, w, tile, acc_dtype, interpret):
     tile = min(tile, ((m + 127) // 128) * 128)
     grid = (pl.cdiv(m, tile),)
     return pl.pallas_call(
-        functools.partial(_kernel, w=w, k=k, p=p, acc_dtype=acc_dtype),
+        functools.partial(
+            _kernel, w=w, k=k, p=p, acc_dtype=acc_dtype, expand=expand
+        ),
         out_shape=jax.ShapeDtypeStruct((p, m), out_dtype),
         grid=grid,
         in_specs=[
@@ -99,6 +127,7 @@ def gf_matmul_pallas(
     tile: int | None = None,
     acc_dtype=None,
     interpret: bool | None = None,
+    expand: str = "shift",
 ):
     """``C = A . B`` over GF(2^w) via the fused Pallas kernel.
 
@@ -107,9 +136,13 @@ def gf_matmul_pallas(
     accumulation, exact for depth < 2^24).  Both bit-verified; defaults are
     the measured-best per backend (v5e sweep 2026-07: int8 @ tile 16384 =
     61.7 GB/s, bf16 @ 2048 = 42.1 GB/s).
+    ``expand``: bit-expansion formulation, "shift" (default) or "sign" (see
+    module docstring).
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
+    if expand not in ("shift", "sign"):
+        raise ValueError(f"unknown expand {expand!r}")
     A = jnp.asarray(A)
     B = jnp.asarray(B)
     if interpret is None:
@@ -118,4 +151,4 @@ def gf_matmul_pallas(
         tile = DEFAULT_TILE if interpret else TPU_TILE
     if acc_dtype is None:
         acc_dtype = jnp.bfloat16 if interpret else jnp.int8
-    return _pallas_matmul(A, B, w, tile, acc_dtype, interpret)
+    return _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand)

@@ -66,3 +66,40 @@ def test_pallas_file_roundtrip(tmp_path):
     out = str(tmp_path / "o")
     api.decode_file(path, conf, out, strategy="pallas")
     assert open(out, "rb").read() == data
+
+
+@pytest.mark.parametrize("expand", ["shift", "sign"])
+def test_pallas_expand_modes(expand):
+    """Both bit-expansion formulations are bit-exact (the sign trick's
+    {0,-1} planes preserve accumulator parity)."""
+    gf = get_field(8)
+    rng = np.random.default_rng(21)
+    A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(10, 1000), dtype=np.uint8)
+    got = np.asarray(gf_matmul_pallas(A, B, expand=expand))
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
+@pytest.mark.parametrize("expand", ["shift", "sign"])
+def test_pallas_wide_symbols(expand):
+    """GF(2^16) through the fused kernel (uint16 lanes, 16 planes)."""
+    gf = get_field(16)
+    rng = np.random.default_rng(22)
+    A = rng.integers(0, 1 << 16, size=(3, 5), dtype=np.uint16)
+    B = rng.integers(0, 1 << 16, size=(5, 600), dtype=np.uint16)
+    got = np.asarray(gf_matmul_pallas(A, B, w=16, expand=expand))
+    assert got.dtype == np.uint16
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
+@pytest.mark.parametrize("expand", ["shift", "sign"])
+def test_pallas_sign_int8_acc(expand):
+    """int8 accumulation path (the TPU default) under both expansions."""
+    import jax.numpy as jnp
+
+    gf = get_field(8)
+    rng = np.random.default_rng(23)
+    A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(10, 512), dtype=np.uint8)
+    got = np.asarray(gf_matmul_pallas(A, B, acc_dtype=jnp.int8, expand=expand))
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
