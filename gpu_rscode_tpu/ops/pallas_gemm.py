@@ -143,6 +143,11 @@ def gf_matmul_pallas(
     """
     if expand not in ("shift", "sign"):
         raise ValueError(f"unknown expand {expand!r}")
+    if expand == "sign" and w not in (8, 16):
+        raise ValueError(
+            f"expand='sign' needs a lane-width field (w=8 or 16), got w={w}; "
+            "use expand='shift' for other widths"
+        )
     A = jnp.asarray(A)
     B = jnp.asarray(B)
     if interpret is None:

@@ -75,14 +75,9 @@ def _body_dma(a_ref, b_ref, o_ref, *, w, k, p):
     o_ref[:] = b_ref[:p, :]
 
 
-def _expand_sign(b_u8, w, k, tile):
-    """Bit-expand staying in 8-bit lanes: plane s = (int8)(b << (7-s)) >> 7,
-    i.e. {0, -1}.  -1 === 1 (mod 2), so the parity of the int32 matmul
-    accumulator is unchanged; 2 ops/plane on packed int8 lanes."""
-    bts = jax.lax.bitcast_convert_type(b_u8, jnp.int8)
-    shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1).astype(jnp.int8)
-    lsh = jnp.int8(7) - shifts
-    return ((bts[:, None, :] << lsh) >> jnp.int8(7)).reshape(k * w, tile)
+# The sign expander is the production one — the sweep must benchmark the
+# exact formulation that ships.
+from ..ops.pallas_gemm import _expand_sign
 
 
 def _body_sign(a_ref, b_ref, o_ref, *, w, k, p):
