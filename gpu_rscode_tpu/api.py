@@ -331,46 +331,59 @@ def decode_file(
     out_path = output or in_file
     seg_cols = _segment_cols(chunk, k, segment_bytes)
     tmp_path = out_path + ".rs_tmp"
-    with open(tmp_path, "wb") as out_fp:
+    # Read fds for the pread gather — only the recovery path stages
+    # segments; the all-natives path copies through the memmaps.
+    fps = [open(p, "rb") for p in paths] if dec_missing is not None else []
+    try:
+        with open(tmp_path, "wb") as out_fp:
 
-        def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
-            lo = i * chunk + off
-            if lo >= total_size:
-                return
-            hi = min(lo + cols, total_size)
-            out_fp.seek(lo)
-            out_fp.write(row_bytes[: hi - lo].tobytes())
+            def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
+                lo = i * chunk + off
+                if lo >= total_size:
+                    return
+                hi = min(lo + cols, total_size)
+                out_fp.seek(lo)
+                out_fp.write(row_bytes[: hi - lo].tobytes())
 
-        def drain(tag, rec):
-            off, cols = tag
-            with timer.phase("decode compute"):
-                rec_np = np.asarray(rec) if rec is not None else None
-            if rec_np is not None and rec_np.dtype != np.uint8:
-                rec_np = np.ascontiguousarray(rec_np).view(np.uint8)  # LE bytes
-            with timer.phase("write output (io)"):
-                for i in range(k):
-                    if i in native_pos:
-                        src_row = maps[native_pos[i]][off : off + cols]
-                        write_row(i, off, cols, src_row)
+            def drain(tag, rec):
+                off, cols = tag
+                with timer.phase("decode compute"):
+                    rec_np = np.asarray(rec) if rec is not None else None
+                if rec_np is not None and rec_np.dtype != np.uint8:
+                    rec_np = np.ascontiguousarray(rec_np).view(np.uint8)  # LE
+                with timer.phase("write output (io)"):
+                    for i in range(k):
+                        if i in native_pos:
+                            src_row = maps[native_pos[i]][off : off + cols]
+                            write_row(i, off, cols, src_row)
+                        else:
+                            write_row(i, off, cols, rec_np[rec_row[i]])
+
+            from . import native
+
+            with AsyncWindow(pipeline_depth, drain) as window:
+                off = 0
+                while off < chunk:
+                    cols = min(seg_cols, chunk - off)
+                    if dec_missing is not None:
+                        with timer.phase("stage segment (io)"):
+                            # Native pread gather (one syscall per surviving
+                            # chunk); memmap copies as fallback.
+                            seg = native.gather_rows(
+                                fps, off, cols, fallback_maps=maps
+                            )
+                        if sym > 1:
+                            seg = seg.view(np.uint16)
+                        with timer.phase("decode dispatch"):
+                            rec = codec.decode(dec_missing, seg)  # async
                     else:
-                        write_row(i, off, cols, rec_np[rec_row[i]])
-
-        with AsyncWindow(pipeline_depth, drain) as window:
-            off = 0
-            while off < chunk:
-                cols = min(seg_cols, chunk - off)
-                if dec_missing is not None:
-                    with timer.phase("stage segment (io)"):
-                        seg = np.stack([mm[off : off + cols] for mm in maps])
-                    if sym > 1:
-                        seg = seg.view(np.uint16)
-                    with timer.phase("decode dispatch"):
-                        rec = codec.decode(dec_missing, seg)  # async
-                else:
-                    rec = None  # all natives survived: pure copy
-                window.push((off, cols), rec)
-                off += cols
-        out_fp.truncate(total_size)
+                        rec = None  # all natives survived: pure copy
+                    window.push((off, cols), rec)
+                    off += cols
+            out_fp.truncate(total_size)
+    finally:
+        for fp in fps:
+            fp.close()
     os.replace(tmp_path, out_path)
     return out_path
 

@@ -80,6 +80,11 @@ def get_lib() -> ctypes.CDLL:
             ctypes.c_longlong, ctypes.c_longlong,
         ]
         lib.rs_scatter_write.restype = ctypes.c_int
+        lib.rs_gather_rows.argtypes = [
+            ctypes.POINTER(ctypes.c_int), u8p, ctypes.c_int,
+            ctypes.c_longlong, ctypes.c_longlong,
+        ]
+        lib.rs_gather_rows.restype = ctypes.c_int
         lib.rs_gf_init()
         _lib = lib
         return lib
@@ -181,3 +186,31 @@ def scatter_write(files, arr: np.ndarray, off: int) -> None:
     fds = (ctypes.c_int * p)(*[fp.fileno() for fp in files])
     if lib.rs_scatter_write(fds, arr, p, cols, off) != 0:
         raise OSError("rs_scatter_write failed (short write)")
+
+
+def gather_rows(files, off: int, cols: int, fallback_maps=None) -> np.ndarray:
+    """(k, cols) segment at byte offset ``off`` of k open chunk files —
+    the decode-side staging twin of :func:`stripe_read` (native pread per
+    row; memmap slice-copy fallback).
+
+    ``files``: open binary file objects (one per surviving chunk).
+    ``fallback_maps``: memmaps used when the native library is
+    unavailable.  Callers invoking this in a per-segment loop should pass
+    them (built once per file set) — omitting them re-mmaps every file on
+    every fallback call and requires ``f.name`` to be a real path.
+    """
+    k = len(files)
+    dst = np.empty((k, cols), dtype=np.uint8)
+    try:
+        lib = get_lib()
+    except NativeUnavailable:
+        maps = fallback_maps
+        if maps is None:
+            maps = [np.memmap(f.name, dtype=np.uint8, mode="r") for f in files]
+        for i in range(k):
+            dst[i] = maps[i][off : off + cols]
+        return dst
+    fds = (ctypes.c_int * k)(*[f.fileno() for f in files])
+    if lib.rs_gather_rows(fds, dst, k, off, cols) != 0:
+        raise OSError("rs_gather_rows failed (short read)")
+    return dst

@@ -183,6 +183,26 @@ long long rs_stripe_read(const char* path, uint8_t* dst, long long chunk,
   return got_total;
 }
 
+// Gather one cols-byte segment at offset off from each of k open chunk
+// files into dst[k x cols] (pread).  The decode-side twin of
+// rs_stripe_read: chunk files are exactly chunk-sized, so a short read is
+// an error (never zero-filled — decoding zeroed data would fabricate
+// output).  Returns 0, or -1 on any read failure.
+int rs_gather_rows(const int* fds, uint8_t* dst, int k, long long off,
+                   long long cols) {
+  for (int i = 0; i < k; ++i) {
+    uint8_t* row = dst + static_cast<long long>(i) * cols;
+    long long done = 0;
+    while (done < cols) {
+      const ssize_t n = pread(fds[i], row + done,
+                              static_cast<size_t>(cols - done), off + done);
+      if (n <= 0) return -1;
+      done += n;
+    }
+  }
+  return 0;
+}
+
 // Scatter p parity row segments to p files at offset off (pwrite).
 // fds: open file descriptors.  Returns 0, or -1 on short write.
 int rs_scatter_write(const int* fds, const uint8_t* src, int p,

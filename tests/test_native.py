@@ -85,3 +85,25 @@ def test_native_cpu_roundtrip():
     surv = list(range(p, p + k))
     rec = native.gemm(native.invert(T[surv]), code[surv])
     np.testing.assert_array_equal(rec, data)
+
+
+def test_gather_rows_matches_memmap(tmp_path):
+    import numpy as np
+
+    from gpu_rscode_tpu import native
+
+    rng = np.random.default_rng(50)
+    paths = []
+    for i in range(3):
+        p = str(tmp_path / f"c{i}")
+        open(p, "wb").write(rng.integers(0, 256, 10_000, dtype=np.uint8).tobytes())
+        paths.append(p)
+    maps = [np.memmap(p, dtype=np.uint8, mode="r") for p in paths]
+    fps = [open(p, "rb") for p in paths]
+    try:
+        got = native.gather_rows(fps, 1234, 4096, fallback_maps=maps)
+        want = np.stack([mm[1234 : 1234 + 4096] for mm in maps])
+        np.testing.assert_array_equal(got, want)
+    finally:
+        for f in fps:
+            f.close()
