@@ -80,7 +80,7 @@ def encode_file(
     parity_num: int,
     *,
     generator: str = "vandermonde",
-    strategy: str = "bitplane",
+    strategy: str = "auto",
     segment_bytes: int = DEFAULT_SEGMENT_BYTES,
     pipeline_depth: int = 2,
     mesh=None,
@@ -215,7 +215,7 @@ def decode_file(
     conf_file: str,
     output: str | None = None,
     *,
-    strategy: str = "bitplane",
+    strategy: str = "auto",
     segment_bytes: int = DEFAULT_SEGMENT_BYTES,
     pipeline_depth: int = 2,
     mesh=None,

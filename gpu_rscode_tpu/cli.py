@@ -9,7 +9,8 @@ was selected first, matching the reference's ordering rule.
 
 Extensions (flagged long options, no reference equivalent):
 ``--generator {vandermonde,cauchy}``,
-``--strategy {bitplane,table,pallas,cpu}``, ``--devices N`` / ``--stripe S``
+``--strategy {auto,bitplane,table,pallas,cpu}`` (default auto: pallas on a
+TPU backend, bitplane elsewhere/on meshes), ``--devices N`` / ``--stripe S``
 (mesh sharding), ``--quiet`` (suppress the timing report),
 ``--profile-dir DIR`` (jax.profiler trace output).
 """
@@ -33,7 +34,8 @@ Performance-tuning options:
 [-p|-P]: column-tile size hint for the GF-GEMM kernel
 [-s|-S]: pipeline depth (segments in flight, default 2)
 Extensions: [--generator vandermonde|cauchy]
-            [--strategy bitplane|table|pallas|cpu]  (cpu = native host codec)
+            [--strategy auto|bitplane|table|pallas|cpu]  (default auto:
+            pallas kernel on TPU, bitplane elsewhere; cpu = host codec)
             [--segment-bytes N] [--quiet] [--profile-dir DIR]
             [--devices N] [--stripe S]  (shard over a device mesh;
             S > 1 additionally shards the stripe/k axis)
@@ -82,7 +84,7 @@ def main(argv: list[str] | None = None) -> int:
     tile_hint = 0
     in_file = conf_file = out_file = None
     op = None
-    generator, strategy = "vandermonde", "bitplane"
+    generator, strategy = "vandermonde", "auto"
     segment_bytes = None
     quiet = False
     profile_dir = None

@@ -27,7 +27,10 @@ class RSCodec:
     ``native_num`` = k data chunks, ``parity_num`` = n - k parity chunks.
     ``generator``: "vandermonde" (reference-compatible: the exact matrix the
     reference generates and stores in .METADATA) or "cauchy" (any-k-subset
-    decodable).  ``strategy``: GEMM strategy ("bitplane" MXU / "table" VPU).
+    decodable).  ``strategy``: GEMM strategy — "auto" (default at the file
+    layer) resolves to the fused Pallas kernel on a real TPU backend and
+    the XLA bitplane path elsewhere; explicit values: "pallas", "bitplane"
+    (MXU), "table" (VPU), "cpu" (native host codec).
     """
 
     def __init__(
@@ -42,6 +45,15 @@ class RSCodec:
     ):
         if native_num < 1 or parity_num < 0:
             raise ValueError(f"bad (k={native_num}, p={parity_num})")
+        if strategy == "auto":
+            # Mesh runs resolve to bitplane: the sharded body has no
+            # Mosaic-failure fallback (a mid-stream kernel failure would
+            # leave partial output files), and stripe sharding is
+            # bitplane-only by construction.
+            if mesh is not None or jax.default_backend() != "tpu":
+                strategy = "bitplane"
+            else:
+                strategy = "pallas"
         self.gf = get_field(w)
         self.w = w
         self.native_num = native_num

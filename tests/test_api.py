@@ -402,3 +402,28 @@ def test_decode_rejects_out_of_range_matrix_entry(tmp_path):
     conf = make_conf(6, 4, path)
     with pytest.raises(ValueError, match="out of range"):
         api.decode_file(path, conf, str(tmp_path / "o"))
+
+
+def test_auto_strategy_resolves_off_tpu(tmp_path):
+    """strategy='auto' must resolve to bitplane on the CPU test backend and
+    round-trip bit-exactly."""
+    from gpu_rscode_tpu.codec import RSCodec
+
+    assert RSCodec(4, 2, strategy="auto").strategy == "bitplane"
+    path = _mkfile(tmp_path, 8_000, seed=51)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2)  # default auto
+    conf = make_conf(6, 4, path)
+    out = str(tmp_path / "o")
+    api.decode_file(path, conf, out)
+    assert open(out, "rb").read() == orig
+
+
+def test_auto_strategy_on_mesh_resolves_bitplane():
+    """auto + mesh must pick the sharded-proven bitplane path (the mesh body
+    has no Mosaic fallback)."""
+    from gpu_rscode_tpu.codec import RSCodec
+    from gpu_rscode_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(4)
+    assert RSCodec(4, 2, strategy="auto", mesh=mesh).strategy == "bitplane"

@@ -49,3 +49,23 @@ def test_strategies_agree(k, p, m, seed):
     np.testing.assert_array_equal(np.asarray(gf_matmul(A, B, strategy="bitplane")), want)
     np.testing.assert_array_equal(np.asarray(gf_matmul(A, B, strategy="table")), want)
     np.testing.assert_array_equal(native.gemm(A, B), want)
+
+
+@settings(max_examples=15, deadline=None)
+@given(
+    k=st.integers(1, 8),
+    p=st.integers(1, 4),
+    m=st.integers(1, 200),
+    seed=st.integers(0, 2**32 - 1),
+)
+def test_wide_symbol_any_subset_recovers(k, p, m, seed):
+    """GF(2^16) stripe round-trip for arbitrary shapes and survivor sets."""
+    codec = RSCodec(k, p, w=16, generator="cauchy")
+    rng = np.random.default_rng(seed)
+    natives = rng.integers(0, 1 << 16, size=(k, m), dtype=np.uint16)
+    parity = np.asarray(codec.encode(natives))
+    code = np.concatenate([natives, parity], axis=0)
+    surv = list(rng.permutation(k + p)[:k])
+    dec = codec.decode_matrix(surv)
+    rec = np.asarray(codec.decode(dec, code[surv]))
+    np.testing.assert_array_equal(rec, natives)
