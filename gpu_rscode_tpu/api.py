@@ -37,6 +37,7 @@ from .utils.fileformat import (
     parse_chunk_index,
     read_conf,
     read_metadata_ext,
+    rewrite_checksums,
     write_conf,
     write_metadata,
 )
@@ -388,6 +389,98 @@ def decode_file(
     return out_path
 
 
+class _ChunkScan:
+    """Result of scanning an encode's chunk set: metadata fields plus which
+    chunk indices are healthy, CRC-failing, or missing."""
+
+    def __init__(self, in_file, total_size, p, k, total_mat, w, crcs,
+                 chunk, healthy, bad):
+        self.in_file = in_file
+        self.total_size = total_size
+        self.p = p
+        self.k = k
+        self.total_mat = total_mat
+        self.w = w
+        self.crcs = crcs
+        self.chunk = chunk
+        self.healthy = healthy          # indices with full-size, CRC-clean files
+        self.bad = bad                  # {index: path} failing CRC
+        self.missing = sorted(
+            set(range(k + p)) - set(healthy) - set(bad)
+        )
+
+    @property
+    def unhealthy(self):
+        """All chunk indices needing repair (corrupt or absent)."""
+        return sorted(set(self.bad) | set(self.missing))
+
+
+def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
+    """Discover chunk health next to ``in_file`` (size + CRC checks)."""
+    meta = metadata_file_name(in_file)
+    total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
+    if w not in (8, 16):
+        raise ValueError(
+            f"unsupported gfwidth {w} in {meta!r} (this build handles 8/16)"
+        )
+    if int(total_mat.max(initial=0)) >= (1 << w):
+        raise ValueError(
+            f"metadata matrix entry {int(total_mat.max())} out of range for "
+            f"GF(2^{w}) — corrupt or foreign .METADATA"
+        )
+    chunk = chunk_size_for(total_size, k, w // 8)
+    healthy: list[int] = []
+    bad: dict[int, str] = {}
+    for i in range(k + p):
+        path = chunk_file_name(in_file, i)
+        if not os.path.exists(path) or os.path.getsize(path) < chunk:
+            continue
+        if i in crcs:
+            mm = np.memmap(path, dtype=np.uint8, mode="r")
+            if chunk_crc32(mm, chunk, segment_bytes) != crcs[i]:
+                bad[i] = path
+                continue
+        healthy.append(i)
+    return _ChunkScan(
+        in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy, bad
+    )
+
+
+def _select_decodable_subset(scan: _ChunkScan):
+    """Pick k healthy chunk indices whose submatrix inverts; returns
+    ``(chosen, inverse)`` so callers don't re-invert.
+
+    Natives-first candidate order (partial recovery makes them free), then
+    parity; lazily falls back through other subsets on singularity.  The cap
+    bounds pathological non-MDS matrices; Vandermonde/Cauchy submatrices
+    are near-always invertible so the first try is the common case.
+    """
+    from itertools import combinations
+
+    from .ops.gf import get_field
+    from .ops.inverse import SingularMatrixError, invert_matrix
+
+    k = scan.k
+    if len(scan.healthy) < k:
+        raise ValueError(
+            f"only {len(scan.healthy)} healthy chunks of the k={k} needed "
+            f"(corrupt: {sorted(scan.bad)}, missing: {scan.missing})"
+        )
+    gf = get_field(scan.w)
+    mat = scan.total_mat.astype(gf.dtype)
+    for attempt, subset in enumerate(combinations(scan.healthy, k)):
+        if attempt >= 100:
+            break
+        try:
+            inv = invert_matrix(mat[list(subset)], gf)
+            return list(subset), inv
+        except SingularMatrixError:
+            continue
+    raise ValueError(
+        f"no decodable k={k} subset among healthy chunks {scan.healthy}"
+    )
+
+
 def auto_decode_file(
     in_file: str,
     output: str | None = None,
@@ -415,64 +508,10 @@ def auto_decode_file(
     Raises ValueError when fewer than k healthy chunks remain or no
     decodable subset exists.  ``decode_kwargs`` pass through to decode_file.
     """
-    from itertools import combinations
-
-    from .ops.inverse import SingularMatrixError
-
-    meta = metadata_file_name(in_file)
-    total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
-    if w in (8, 16) and int(total_mat.max(initial=0)) >= (1 << w):
-        raise ValueError(
-            f"metadata matrix entry {int(total_mat.max())} out of range for "
-            f"GF(2^{w}) — corrupt or foreign .METADATA"
-        )
-    sym = w // 8 if w in (8, 16) else 1
-    chunk = chunk_size_for(total_size, k, sym)
-
-    healthy: list[int] = []
-    bad: dict[int, str] = {}
-    for i in range(k + p):
-        path = chunk_file_name(in_file, i)
-        if not os.path.exists(path) or os.path.getsize(path) < chunk:
-            continue
-        if i in crcs:
-            mm = np.memmap(path, dtype=np.uint8, mode="r")
-            step = decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
-            if chunk_crc32(mm, chunk, step) != crcs[i]:
-                bad[i] = path
-                continue
-        healthy.append(i)
-
-    if len(healthy) < k:
-        raise ValueError(
-            f"only {len(healthy)} healthy chunks of the k={k} needed "
-            f"(corrupt: {sorted(bad)}, missing: "
-            f"{sorted(set(range(k + p)) - set(healthy) - set(bad))})"
-        )
-
-    # Natives-first candidate order (partial recovery makes them free), then
-    # parity; lazily fall back through other subsets on singularity.  The cap
-    # bounds pathological non-MDS matrices; Vandermonde/Cauchy submatrices
-    # are near-always invertible so the first try is the common case.
-    from .ops.inverse import invert_matrix
-    from .ops.gf import get_field
-
-    gf = get_field(w)
-    mat = total_mat.astype(gf.dtype)
-    chosen = None
-    for attempt, subset in enumerate(combinations(healthy, k)):
-        if attempt >= 100:
-            break
-        try:
-            invert_matrix(mat[list(subset)], gf)
-            chosen = list(subset)
-            break
-        except SingularMatrixError:
-            continue
-    if chosen is None:
-        raise ValueError(
-            f"no decodable k={k} subset among healthy chunks {healthy}"
-        )
+    scan = _scan_chunks(
+        in_file, decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
+    )
+    chosen, _ = _select_decodable_subset(scan)
 
     conf_path = conf_out or (in_file + ".auto.conf")
     write_conf(
@@ -485,3 +524,107 @@ def auto_decode_file(
     if decode_kwargs.get("verify_checksums") is None:
         decode_kwargs["verify_checksums"] = False
     return decode_file(in_file, conf_path, output, **decode_kwargs)
+
+
+def repair_file(
+    in_file: str,
+    *,
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    pipeline_depth: int = 2,
+    timer: PhaseTimer | None = None,
+) -> list[int]:
+    """Regenerate every lost or corrupt chunk of an encode, in place.
+
+    The reference can only restore the original *file* (decode.cu); a
+    storage deployment also needs to heal the *archive* — rebuild missing
+    chunk files, parity included, so future failures stay survivable.  Any
+    target chunk row t is a GF-linear function of any decodable survivor
+    set: ``row_t = T[t] . inv(T[surv])``, so all targets rebuild in ONE
+    streamed GEMM over the survivors (natives and parity alike — no
+    decode-then-re-encode double pass).
+
+    Returns the list of chunk indices rebuilt ([] when the archive is
+    already healthy).  Rebuilt chunks' CRC lines in .METADATA are refreshed
+    when checksums are present.  Raises ValueError when fewer than k
+    healthy chunks remain.
+    """
+    from .ops.gf import get_field
+
+    timer = timer or PhaseTimer(enabled=False)
+    with timer.phase("scan chunks (io)"):
+        scan = _scan_chunks(in_file, segment_bytes)
+    targets = scan.unhealthy
+    if not targets:
+        return []
+    with timer.phase("invert matrix"):
+        chosen, inv = _select_decodable_subset(scan)
+        gf = get_field(scan.w)
+        mat = scan.total_mat.astype(gf.dtype)
+        rebuild_mat = gf.matmul(mat[targets], inv)  # (targets, k)
+
+    codec = RSCodec(scan.k, scan.p, w=scan.w, strategy=strategy)
+    sym = scan.w // 8
+    chunk = scan.chunk
+    seg_cols = _segment_cols(chunk, scan.k, segment_bytes)
+
+    from . import native
+
+    surv_fps = [open(chunk_file_name(in_file, i), "rb") for i in chosen]
+    surv_maps = [
+        np.memmap(chunk_file_name(in_file, i), dtype=np.uint8, mode="r")
+        for i in chosen
+    ]
+    # Rebuild into temp files; atomically swap in only when every segment
+    # landed (a failed repair must not destroy a corrupt-but-present chunk:
+    # its surviving bytes may still matter to a different recovery tool).
+    tmp_paths = {t: chunk_file_name(in_file, t) + ".rs_tmp" for t in targets}
+    out_fps = {t: open(tmp_paths[t], "wb") for t in targets}
+    new_crcs: dict[int, int] = {}
+
+    def drain(tag, rebuilt):
+        off, cols = tag
+        with timer.phase("repair compute"):
+            reb = np.asarray(rebuilt)
+        if reb.dtype != np.uint8:
+            reb = np.ascontiguousarray(reb).view(np.uint8)
+        with timer.phase("write chunks (io)"):
+            native.scatter_write([out_fps[t] for t in targets], reb, off)
+        if scan.crcs:
+            for j, t in enumerate(targets):
+                new_crcs[t] = crc32_of(reb[j], new_crcs.get(t, 0))
+
+    try:
+        with AsyncWindow(pipeline_depth, drain) as window:
+            off = 0
+            while off < chunk:
+                cols = min(seg_cols, chunk - off)
+                with timer.phase("stage segment (io)"):
+                    seg = native.gather_rows(
+                        surv_fps, off, cols, fallback_maps=surv_maps
+                    )
+                if sym > 1:
+                    seg = seg.view(np.uint16)
+                with timer.phase("repair dispatch"):
+                    rebuilt = codec.decode(rebuild_mat, seg)  # async GEMM
+                window.push((off, cols), rebuilt)
+                off += cols
+        for t in targets:
+            out_fps[t].close()
+        for t in targets:
+            os.replace(tmp_paths[t], chunk_file_name(in_file, t))
+    finally:
+        for fp in surv_fps:
+            fp.close()
+        for t, fp in out_fps.items():
+            if not fp.closed:
+                fp.close()
+            if os.path.exists(tmp_paths[t]):
+                os.unlink(tmp_paths[t])
+
+    if scan.crcs:
+        with timer.phase("write metadata (io)"):
+            rewrite_checksums(
+                metadata_file_name(in_file), {**scan.crcs, **new_crcs}
+            )
+    return targets

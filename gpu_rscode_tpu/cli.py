@@ -45,6 +45,8 @@ Extensions: [--generator vandermonde|cauchy]
             extension recorded in .METADATA, decode auto-detects)
             [--auto] (decode without -c: discover healthy chunks, skip
             corrupt ones via CRC32, pick a decodable subset)
+            [--repair] (with -i: rebuild every lost/corrupt chunk in place,
+            parity included; refreshes CRC lines)
 """
 
 
@@ -72,6 +74,7 @@ def main(argv: list[str] | None = None) -> int:
                 "no-verify",
                 "width=",
                 "auto",
+                "repair",
             ],
         )
     except getopt.GetoptError as e:
@@ -94,7 +97,9 @@ def main(argv: list[str] | None = None) -> int:
     no_verify = False
     width = 8
     auto = False
+    repair = False
 
+    repair_requested = any(fl == "--repair" for fl, _ in opts)
     for flag, val in opts:
         f = flag.lower()
         if f in ("-s",):
@@ -110,7 +115,9 @@ def main(argv: list[str] | None = None) -> int:
         elif f in ("-d",):
             op = "decode"
         elif f in ("-i", "-c", "-o"):
-            if op != "decode":
+            # -i is also the --repair target; the reference ordering rule
+            # (-i/-c/-o only after -d) applies to the reference surface.
+            if op != "decode" and not (f == "-i" and repair_requested):
                 return _fail(f"rs: {flag} is only valid after -d (decode)")
             if f == "-i":
                 in_file = val
@@ -143,9 +150,19 @@ def main(argv: list[str] | None = None) -> int:
             width = int(val)
         elif f == "--auto":
             auto = True
+        elif f == "--repair":
+            repair = True
 
+    if repair:
+        if op == "encode" or auto or conf_file or out_file:
+            return _fail("rs: --repair takes only -i (plus tuning flags)")
+        if n_devices:
+            return _fail("rs: --repair does not support --devices (single-device GEMM)")
+        op = "repair"
     if op is None:
-        return _fail("rs: choose encode (-e) or decode (-d)")
+        return _fail("rs: choose encode (-e), decode (-d), or --repair -i <file>")
+    if op == "repair" and not in_file:
+        return _fail("rs: --repair requires -i")
     if checksum and op != "encode":
         return _fail("rs: --checksum is encode-only (decode verifies automatically)")
     if no_verify and op != "decode":
@@ -201,6 +218,22 @@ def main(argv: list[str] | None = None) -> int:
                 **kwargs,
             )
             nbytes = os.path.getsize(in_file)
+        elif op == "repair":
+            rebuilt = api.repair_file(
+                in_file,
+                strategy=strategy,
+                pipeline_depth=max(1, pipeline_depth),
+                **(
+                    {"segment_bytes": kwargs["segment_bytes"]}
+                    if "segment_bytes" in kwargs
+                    else {}
+                ),
+                timer=timer,
+            )
+            print(
+                f"rebuilt chunks: {rebuilt}" if rebuilt else "archive healthy"
+            )
+            nbytes = os.path.getsize(in_file) if os.path.exists(in_file) else 0
         else:
             if not in_file or (not conf_file and not auto):
                 return _fail("rs: decoding requires -i and -c (or --auto)")

@@ -140,6 +140,23 @@ def append_checksums(path: str, crcs: dict[int, int]) -> None:
             fp.write(f"# crc32 {i} {crcs[i] & 0xFFFFFFFF:08x}\n")
 
 
+def rewrite_checksums(path: str, crcs: dict[int, int]) -> None:
+    """Replace ALL ``# crc32`` lines of a metadata file with ``crcs``
+    (repair refreshes rebuilt chunks' CRCs; other extension lines and the
+    base format are preserved byte-for-byte)."""
+    with open(path) as fp:
+        lines = fp.readlines()
+    kept = [
+        ln for ln in lines
+        if not (ln.split()[:2] == ["#", "crc32"] if ln.strip() else False)
+    ]
+    with open(path + ".tmp", "w") as fp:
+        fp.writelines(kept)
+        for i in sorted(crcs):
+            fp.write(f"# crc32 {i} {crcs[i] & 0xFFFFFFFF:08x}\n")
+    os.replace(path + ".tmp", path)
+
+
 def _parse_checksums(text: str) -> dict[int, int]:
     crcs: dict[int, int] = {}
     for line in text.splitlines():
