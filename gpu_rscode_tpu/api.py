@@ -404,7 +404,7 @@ class _ChunkScan:
         self.crcs = crcs
         self.chunk = chunk
         self.healthy = healthy          # indices with full-size, CRC-clean files
-        self.bad = bad                  # {index: path} failing CRC
+        self.bad = bad                  # {index: path} damaged: truncated or CRC-fail
         self.missing = sorted(
             set(range(k + p)) - set(healthy) - set(bad)
         )
@@ -433,7 +433,10 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
     bad: dict[int, str] = {}
     for i in range(k + p):
         path = chunk_file_name(in_file, i)
-        if not os.path.exists(path) or os.path.getsize(path) < chunk:
+        if not os.path.exists(path):
+            continue
+        if os.path.getsize(path) < chunk:
+            bad[i] = path  # present but truncated — damage, not loss
             continue
         if i in crcs:
             mm = np.memmap(path, dtype=np.uint8, mode="r")
@@ -628,3 +631,31 @@ def repair_file(
                 metadata_file_name(in_file), {**scan.crcs, **new_crcs}
             )
     return targets
+
+
+def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> dict:
+    """Read-only archive health report (the scrubbing half of repair).
+
+    Returns ``{"k", "p", "w", "checksummed", "healthy", "corrupt",
+    "missing", "decodable"}`` — ``corrupt`` lists present-but-damaged
+    chunks (truncated or CRC-failing), ``missing`` absent ones, and
+    ``decodable`` means the original file can be rebuilt (>= k healthy
+    chunks with an invertible subset) — which equally means every damaged
+    chunk is repairable.
+    """
+    scan = _scan_chunks(in_file, segment_bytes)
+    try:
+        _select_decodable_subset(scan)
+        ok = True
+    except ValueError:
+        ok = False
+    return {
+        "k": scan.k,
+        "p": scan.p,
+        "w": scan.w,
+        "checksummed": bool(scan.crcs),
+        "healthy": scan.healthy,
+        "corrupt": sorted(scan.bad),  # present but truncated or CRC-failing
+        "missing": scan.missing,      # absent files
+        "decodable": ok,              # decodable implies repairable (one GEMM)
+    }

@@ -47,6 +47,7 @@ Extensions: [--generator vandermonde|cauchy]
             corrupt ones via CRC32, pick a decodable subset)
             [--repair] (with -i: rebuild every lost/corrupt chunk in place,
             parity included; refreshes CRC lines)
+            [--scrub]  (with -i: read-only health report as one JSON line)
 """
 
 
@@ -75,6 +76,7 @@ def main(argv: list[str] | None = None) -> int:
                 "width=",
                 "auto",
                 "repair",
+                "scrub",
             ],
         )
     except getopt.GetoptError as e:
@@ -98,8 +100,9 @@ def main(argv: list[str] | None = None) -> int:
     width = 8
     auto = False
     repair = False
+    scrub = False
 
-    repair_requested = any(fl == "--repair" for fl, _ in opts)
+    repair_requested = any(fl in ("--repair", "--scrub") for fl, _ in opts)
     for flag, val in opts:
         f = flag.lower()
         if f in ("-s",):
@@ -152,17 +155,25 @@ def main(argv: list[str] | None = None) -> int:
             auto = True
         elif f == "--repair":
             repair = True
+        elif f == "--scrub":
+            scrub = True
 
+    if repair and scrub:
+        return _fail("rs: --repair and --scrub conflict")
     if repair:
         if op == "encode" or auto or conf_file or out_file:
             return _fail("rs: --repair takes only -i (plus tuning flags)")
         if n_devices:
             return _fail("rs: --repair does not support --devices (single-device GEMM)")
         op = "repair"
+    if scrub:
+        if op == "encode" or auto or conf_file or out_file or n_devices:
+            return _fail("rs: --scrub takes only -i")
+        op = "scrub"
     if op is None:
         return _fail("rs: choose encode (-e), decode (-d), or --repair -i <file>")
-    if op == "repair" and not in_file:
-        return _fail("rs: --repair requires -i")
+    if op in ("repair", "scrub") and not in_file:
+        return _fail(f"rs: --{op} requires -i")
     if checksum and op != "encode":
         return _fail("rs: --checksum is encode-only (decode verifies automatically)")
     if no_verify and op != "decode":
@@ -218,6 +229,19 @@ def main(argv: list[str] | None = None) -> int:
                 **kwargs,
             )
             nbytes = os.path.getsize(in_file)
+        elif op == "scrub":
+            import json
+
+            report = api.scan_file(
+                in_file,
+                **(
+                    {"segment_bytes": kwargs["segment_bytes"]}
+                    if "segment_bytes" in kwargs
+                    else {}
+                ),
+            )
+            print(json.dumps(report))
+            return 0 if report["decodable"] else 1
         elif op == "repair":
             rebuilt = api.repair_file(
                 in_file,

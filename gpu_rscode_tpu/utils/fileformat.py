@@ -146,10 +146,7 @@ def rewrite_checksums(path: str, crcs: dict[int, int]) -> None:
     base format are preserved byte-for-byte)."""
     with open(path) as fp:
         lines = fp.readlines()
-    kept = [
-        ln for ln in lines
-        if not (ln.split()[:2] == ["#", "crc32"] if ln.strip() else False)
-    ]
+    kept = [ln for ln in lines if ln.split()[:2] != ["#", "crc32"]]
     with open(path + ".tmp", "w") as fp:
         fp.writelines(kept)
         for i in sorted(crcs):

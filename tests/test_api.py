@@ -494,3 +494,19 @@ def test_repair_too_many_losses(tmp_path):
         os.remove(chunk_file_name(path, i))
     with pytest.raises(ValueError, match="healthy"):
         api.repair_file(path)
+
+
+def test_scan_reports_truncated_as_corrupt(tmp_path):
+    """A present-but-truncated chunk is damage, not loss — it must appear
+    under 'corrupt' in the health report and be repairable in place."""
+    path = _mkfile(tmp_path, 12_000, seed=65)
+    api.encode_file(path, 4, 2, checksums=True)
+    victim = chunk_file_name(path, 2)
+    golden = open(victim, "rb").read()
+    open(victim, "wb").write(golden[:-50])  # truncate
+    report = api.scan_file(path)
+    assert report["corrupt"] == [2]
+    assert report["missing"] == []
+    assert report["decodable"]
+    assert api.repair_file(path) == [2]
+    assert open(victim, "rb").read() == golden

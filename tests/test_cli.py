@@ -66,3 +66,37 @@ def test_cli_n_not_greater_than_k(tmp_path):
 
 def test_cli_missing_file_error():
     assert main(["-k", "4", "-n", "6", "-e", "/nonexistent/file", "--quiet"]) == 1
+
+
+def test_cli_scrub_reports_health(tmp_path, capsys):
+    import json
+    import os
+
+    import numpy as np
+
+    from gpu_rscode_tpu import cli
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    path = str(tmp_path / "f.bin")
+    open(path, "wb").write(
+        np.random.default_rng(71).integers(0, 256, 6000, dtype=np.uint8).tobytes()
+    )
+    assert cli.main(["-k", "3", "-n", "5", "-e", path, "--checksum", "--quiet"]) == 0
+    assert cli.main(["--scrub", "-i", path]) == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["healthy"] == [0, 1, 2, 3, 4]
+    assert report["decodable"] and report["checksummed"]
+    # corrupt one chunk: still decodable, reported as corrupt
+    victim = chunk_file_name(path, 1)
+    d = bytearray(open(victim, "rb").read())
+    d[3] ^= 1
+    open(victim, "wb").write(bytes(d))
+    assert cli.main(["--scrub", "-i", path]) == 0
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert report["corrupt"] == [1]
+    # drop too many: exit 1, not decodable
+    for i in (0, 2, 3):
+        os.remove(chunk_file_name(path, i))
+    assert cli.main(["--scrub", "-i", path]) == 1
+    report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert not report["decodable"]
