@@ -100,3 +100,24 @@ def test_cli_scrub_reports_health(tmp_path, capsys):
     assert cli.main(["--scrub", "-i", path]) == 1
     report = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert not report["decodable"]
+
+
+def test_cli_devices_roundtrip(tmp_path):
+    import numpy as np
+
+    from gpu_rscode_tpu import cli
+
+    path = str(tmp_path / "f.bin")
+    data = np.random.default_rng(72).integers(0, 256, 9999, dtype=np.uint8).tobytes()
+    open(path, "wb").write(data)
+    assert cli.main(
+        ["-k", "4", "-n", "6", "-e", path, "--devices", "8", "--quiet"]
+    ) == 0
+    from gpu_rscode_tpu.tools.make_conf import make_conf
+
+    conf = make_conf(6, 4, path)
+    out = str(tmp_path / "o")
+    assert cli.main(
+        ["-d", "-i", path, "-c", conf, "-o", out, "--devices", "8", "--quiet"]
+    ) == 0
+    assert open(out, "rb").read() == data
