@@ -243,6 +243,8 @@ def decode_file(
             f"unsupported gfwidth {w} in {metadata_file_name(in_file)!r} "
             "(this build decodes w=8 and w=16 files)"
         )
+    if total_mat is None:
+        total_mat = _regenerate_total_matrix(p, k, w)
     if int(total_mat.max(initial=0)) >= (1 << w):
         raise ValueError(
             f"metadata matrix entry {int(total_mat.max())} out of range for "
@@ -389,6 +391,15 @@ def decode_file(
     return out_path
 
 
+def _regenerate_total_matrix(p: int, k: int, w: int) -> np.ndarray:
+    """Canonical [I; Vandermonde] total matrix for sizes-only (CPU-RS
+    dialect) metadata — bit-identical to the reference's regeneration."""
+    from .models.vandermonde import total_matrix
+    from .ops.gf import get_field
+
+    return total_matrix(p, k, get_field(w))
+
+
 class _ChunkScan:
     """Result of scanning an encode's chunk set: metadata fields plus which
     chunk indices are healthy, CRC-failing, or missing."""
@@ -423,6 +434,8 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
         raise ValueError(
             f"unsupported gfwidth {w} in {meta!r} (this build handles 8/16)"
         )
+    if total_mat is None:
+        total_mat = _regenerate_total_matrix(p, k, w)
     if int(total_mat.max(initial=0)) >= (1 << w):
         raise ValueError(
             f"metadata matrix entry {int(total_mat.max())} out of range for "

@@ -98,18 +98,33 @@ def read_metadata_ext(path: str):
     )
 
 
-def read_metadata(path: str) -> tuple[int, int, int, np.ndarray]:
-    """Returns (total_size, parity_num, native_num, total_matrix)."""
+def read_metadata(path: str) -> tuple[int, int, int, np.ndarray | None]:
+    """Returns (total_size, parity_num, native_num, total_matrix).
+
+    ``total_matrix`` is None for the reference's sizes-only CPU-RS
+    metadata dialect (the caller regenerates the canonical Vandermonde
+    total matrix — see :func:`_parse_metadata`)."""
     with open(path) as fp:
         return _parse_metadata(fp.read(), path)
 
 
-def _parse_metadata(text: str, path: str) -> tuple[int, int, int, np.ndarray]:
-    tokens = text.split()
+def _parse_metadata(text: str, path: str):
+    # Base tokens exclude extension/comment lines ("#"-prefixed) wherever
+    # they appear.
+    tokens: list[str] = []
+    for line in text.splitlines():
+        if line.lstrip().startswith("#"):
+            continue
+        tokens += line.split()
     if len(tokens) < 3:
         raise ValueError(f"malformed metadata file {path!r}")
     total_size, parity_num, native_num = int(tokens[0]), int(tokens[1]), int(tokens[2])
     want = (native_num + parity_num) * native_num
+    if len(tokens) == 3:
+        # The reference's CPU-RS dialect: sizes only, no matrix — decode
+        # regenerates the canonical [I; Vandermonde] deterministically
+        # (cpu-rs.c write_metadata:465-476 / gen_total_encoding_matrix:621).
+        return total_size, parity_num, native_num, None
     mat_tokens = tokens[3 : 3 + want]
     if len(mat_tokens) != want:
         raise ValueError(
