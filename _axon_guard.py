@@ -1,0 +1,100 @@
+"""Shared defusal of the axon PJRT plugin landmine (stdlib-only).
+
+The axon sitecustomize (``/root/.axon_site``, on PYTHONPATH in every
+interpreter) registers a PJRT backend factory whose client-create dials the
+real-TPU tunnel and can BLOCK indefinitely when the tunnel is busy — even
+under ``JAX_PLATFORMS=cpu`` (round-1 postmortem: MULTICHIP_r01 rc=124).
+Any process that must never touch the tunnel — unit tests, the multi-chip
+dry run, bench's cpu fallback — calls :func:`defuse_axon` BEFORE jax
+backend initialisation.  One copy of the dance, used by tests/conftest.py,
+__graft_entry__.py and bench.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+
+def defuse_axon(
+    n_devices: int | None = None,
+    *,
+    allow_initialised: bool = False,
+    override_count: bool = True,
+):
+    """Force JAX onto the in-process CPU backend with axon deregistered.
+
+    ``n_devices``: virtual CPU device count to pin via
+    ``--xla_force_host_platform_device_count``; ``None`` leaves XLA_FLAGS
+    untouched.  When the flag already exists with a different count,
+    ``override_count=True`` rewrites it (the dry run must arm exactly
+    n_devices) while ``False`` preserves it (the test suite honours an
+    external wider-mesh override).
+
+    Backend *initialisation* is lazy, so this works even if jax is already
+    imported — but not if a backend was already built (env/config changes
+    are no-ops then).  In that case: raise by default (the conftest
+    contract), or with ``allow_initialised=True`` clear jax's backend state
+    best-effort so the next init sees the forced config.
+
+    Returns the ``jax`` module.
+    """
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices is not None:
+        flags = os.environ.get("XLA_FLAGS", "")
+        opt = f"--xla_force_host_platform_device_count={n_devices}"
+        if "xla_force_host_platform_device_count" in flags:
+            if override_count:
+                flags = re.sub(
+                    r"--xla_force_host_platform_device_count=\d+", opt, flags
+                )
+        else:
+            flags = (flags + " " + opt).strip()
+        os.environ["XLA_FLAGS"] = flags
+    # Keep the plugin modules out of the process entirely.
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    for m in [m for m in sys.modules if m == "axon" or m.startswith("axon.")]:
+        del sys.modules[m]
+    import jax._src.xla_bridge as xb
+
+    # Deregister only the axon factory; the stock "tpu" factory stays (pallas
+    # needs the platform known for lowering registration) — it is never
+    # initialised under JAX_PLATFORMS=cpu.
+    xb._backend_factories.pop("axon", None)
+    if xb._backends:
+        if not allow_initialised:
+            raise RuntimeError(
+                "jax backends initialised before defuse_axon() could force cpu"
+            )
+        _clear_backends(xb)
+    import jax
+
+    # Load-bearing: the axon register module pins jax_platforms to "axon" at
+    # config level, overriding the env var — the update must actually land.
+    jax.config.update("jax_platforms", "cpu")
+    if jax.config.jax_platforms != "cpu":
+        raise RuntimeError(
+            f"could not force jax_platforms=cpu (still {jax.config.jax_platforms!r})"
+        )
+    return jax
+
+
+def _clear_backends(xb) -> None:
+    """Best-effort reset of jax's backend-selection state so a new
+    configuration can take effect after a (failed or unwanted) init."""
+    for name in ("_backends", "_backends_errors"):
+        try:
+            getattr(xb, name).clear()
+        except Exception:
+            pass
+    try:
+        xb._default_backend = None
+    except Exception:
+        pass
+    try:
+        import jax
+
+        jax.clear_caches()  # jitted executables are keyed to dead devices
+    except Exception:
+        pass

@@ -4,39 +4,20 @@ Multi-chip sharding is validated on virtual CPU devices
 (``--xla_force_host_platform_device_count``) because CI has at most one real
 TPU chip; the sharded code paths are identical.  Must run before jax import.
 
-Two environment landmines handled here (see .claude/skills/verify/SKILL.md):
-- the outer env pins ``JAX_PLATFORMS=axon`` (real-TPU tunnel) — tests must
-  force ``cpu`` or they grab the single chip and its remote-compile path;
-- the axon plugin at ``/root/.axon_site`` initialises its backend even under
-  ``JAX_PLATFORMS=cpu`` and blocks when the tunnel is busy — strip it from
-  ``sys.path`` so unit tests never touch the tunnel at all.
+Two environment landmines (see .claude/skills/verify/SKILL.md): the outer
+env pins ``JAX_PLATFORMS=axon`` (real-TPU tunnel), and the axon plugin at
+``/root/.axon_site`` initialises its backend even under ``JAX_PLATFORMS=cpu``
+and blocks when the tunnel is busy.  Both are defused by the shared
+``_axon_guard.defuse_axon`` (one copy of the dance, also used by
+``__graft_entry__.py`` and ``bench.py``); here it must find jax backends
+still uninitialised — the default — or the forced config could not apply.
 """
 
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-
-# jax may already be imported (pytest's jaxtyping plugin pulls it in), but
-# backend *initialisation* is lazy, so the env vars above still take effect —
-# as long as the axon plugin modules are kept out of the process.
-sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
-for _m in [m for m in sys.modules if m == "axon" or m.startswith("axon.")]:
-    del sys.modules[_m]
-import jax._src.xla_bridge as _xb  # noqa: E402
-
-assert not _xb._backends, "JAX backends initialised before conftest could force cpu"
-# The axon sitecustomize registers its PJRT factory in every interpreter; its
-# client-create blocks whenever the tunnel is busy, even under
-# JAX_PLATFORMS=cpu.  Deregister it so unit tests never dial the tunnel.
-# Keep the stock "tpu" factory registered (pallas needs the platform known
-# for lowering registration); it is never initialised under JAX_PLATFORMS=cpu.
-_xb._backend_factories.pop("axon", None)
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")  # register() pins this to axon
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from _axon_guard import defuse_axon  # noqa: E402
+
+defuse_axon(8)

@@ -78,14 +78,21 @@ def test_reference_encodes_we_decode(cpu_rs, tmp_path):
 def test_we_encode_reference_decodes(cpu_rs, tmp_path):
     """Our encode -> CPU-RS decode (it regenerates the matrix itself, so
     this passes only if our Vandermonde and chunk layout are bit-identical
-    to the reference's)."""
+    to the reference's).
+
+    Survivor set {0,1,4,5}: erase natives 2,3 so real inversion happens, but
+    keep the submatrix pivot-safe — the reference's Gauss-Jordan mishandles
+    zero diagonal pivots (column-swap bug, cpu-rs.c:229-233; SURVEY §2
+    "document, do NOT reproduce"), so survivor sets that force column
+    pivoting (e.g. {2,3,4,5}) corrupt even the reference's OWN round-trip.
+    That divergence is pinned separately below."""
     from gpu_rscode_tpu import api
     from gpu_rscode_tpu.tools.make_conf import make_conf
 
     path = _mkfile(tmp_path, 50_000, seed=92)
     orig = open(path, "rb").read()
     api.encode_file(path, 4, 2)
-    conf = make_conf(6, 4, path)
+    conf = make_conf(6, 4, path, survivors=[0, 1, 4, 5])
     out = str(tmp_path / "ref.bin")
     _run(
         cpu_rs,
@@ -94,6 +101,37 @@ def test_we_encode_reference_decodes(cpu_rs, tmp_path):
         str(tmp_path),
     )
     assert open(out, "rb").read() == orig
+
+
+def test_reference_zero_pivot_divergence(cpu_rs, tmp_path):
+    """Documented divergence: survivor set {2,3,4,5} (drop natives 0,1) puts
+    a zero at pivot (0,0) of the k x k submatrix, forcing column pivoting —
+    which the reference's invert_matrix botches (it swaps the inverse
+    accumulator's columns into the wrong slot, cpu-rs.c:229-233).  The
+    reference corrupts its OWN encode on this conf; our row-pivoting
+    inverter decodes the same chunks correctly."""
+    from gpu_rscode_tpu import api
+    from gpu_rscode_tpu.tools.make_conf import make_conf
+
+    path = _mkfile(tmp_path, 50_000, seed=94)
+    orig = open(path, "rb").read()
+    _run(cpu_rs, ["-k", "4", "-n", "6", "-e", os.path.basename(path)], str(tmp_path))
+    conf = make_conf(6, 4, path, survivors=[2, 3, 4, 5])
+
+    ref_out = str(tmp_path / "ref.bin")
+    r = subprocess.run(
+        [cpu_rs, "-d", "-i", os.path.basename(path), "-c", os.path.basename(conf),
+         "-o", os.path.basename(ref_out)],
+        cwd=str(tmp_path), capture_output=True, text=True,
+    )
+    ref_bytes = open(ref_out, "rb").read() if os.path.exists(ref_out) else b""
+    assert r.returncode != 0 or ref_bytes != orig, (
+        "reference column-swap bug no longer reproduces; revisit SURVEY §2"
+    )
+
+    our_out = str(tmp_path / "ours.bin")
+    api.decode_file(path, conf, our_out)
+    assert open(our_out, "rb").read() == orig
 
 
 def test_parity_chunks_bit_identical(cpu_rs, tmp_path):
