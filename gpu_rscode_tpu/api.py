@@ -44,6 +44,13 @@ from .utils.fileformat import (
 from .utils.timing import PhaseTimer
 
 
+class UndecidedSubsetError(ValueError):
+    """The decodable-subset search hit its candidate cap without finding an
+    invertible k-subset.  Distinct from exhaustion: more combinations exist,
+    so the archive is NOT proven unrecoverable (scan_file reports this as
+    ``decodable: "unknown"`` rather than false)."""
+
+
 class ChunkIntegrityError(ValueError):
     """A surviving chunk's bytes do not match its recorded CRC32.
 
@@ -484,14 +491,25 @@ def _select_decodable_subset(scan: _ChunkScan):
         )
     gf = get_field(scan.w)
     mat = scan.total_mat.astype(gf.dtype)
+    capped = False
     for attempt, subset in enumerate(combinations(scan.healthy, k)):
         if attempt >= 100:
+            capped = True
             break
         try:
             inv = invert_matrix(mat[list(subset)], gf)
             return list(subset), inv
         except SingularMatrixError:
             continue
+    # Distinguish "search space exhausted" from "search cap hit": with the
+    # cap hit, a later subset could still invert, so the archive is not
+    # proven unrecoverable.
+    if capped:
+        raise UndecidedSubsetError(
+            f"no decodable k={k} subset within the first 100 candidate "
+            f"subsets of healthy chunks {scan.healthy}; more combinations "
+            "exist — this archive is not proven unrecoverable"
+        )
     raise ValueError(
         f"no decodable k={k} subset among healthy chunks {scan.healthy}"
     )
@@ -523,6 +541,12 @@ def auto_decode_file(
 
     Raises ValueError when fewer than k healthy chunks remain or no
     decodable subset exists.  ``decode_kwargs`` pass through to decode_file.
+
+    Integrity note: the scan CRC-verifies the chunks it selects, and the
+    inner decode skips re-verification by default — corruption appearing in
+    the scan-to-decode window (TOCTOU) would decode silently.  Callers
+    needing end-to-end integrity on live-mutating storage should pass
+    ``verify_checksums=True`` explicitly to re-check at read time.
     """
     scan = _scan_chunks(
         in_file, decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
@@ -548,6 +572,8 @@ def repair_file(
     strategy: str = "auto",
     segment_bytes: int = DEFAULT_SEGMENT_BYTES,
     pipeline_depth: int = 2,
+    mesh=None,
+    stripe_sharded: bool = False,
     timer: PhaseTimer | None = None,
 ) -> list[int]:
     """Regenerate every lost or corrupt chunk of an encode, in place.
@@ -564,6 +590,11 @@ def repair_file(
     already healthy).  Rebuilt chunks' CRC lines in .METADATA are refreshed
     when checksums are present.  Raises ValueError when fewer than k
     healthy chunks remain.
+
+    With a ``mesh`` the rebuild GEMM fans out across devices exactly like
+    encode/decode (archive repair is the same bulk-data shape — the
+    reference runs its decode multi-GPU, decode.cu:335-378);
+    ``stripe_sharded`` additionally shards the survivor/k axis.
     """
     from .ops.gf import get_field
 
@@ -579,7 +610,10 @@ def repair_file(
         mat = scan.total_mat.astype(gf.dtype)
         rebuild_mat = gf.matmul(mat[targets], inv)  # (targets, k)
 
-    codec = RSCodec(scan.k, scan.p, w=scan.w, strategy=strategy)
+    codec = RSCodec(
+        scan.k, scan.p, w=scan.w, strategy=strategy,
+        mesh=mesh, stripe_sharded=stripe_sharded,
+    )
     sym = scan.w // 8
     chunk = scan.chunk
     seg_cols = _segment_cols(chunk, scan.k, segment_bytes)
@@ -654,12 +688,16 @@ def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> di
     chunks (truncated or CRC-failing), ``missing`` absent ones, and
     ``decodable`` means the original file can be rebuilt (>= k healthy
     chunks with an invertible subset) — which equally means every damaged
-    chunk is repairable.
+    chunk is repairable.  ``decodable`` is tri-state: ``True`` / ``False``
+    / ``"unknown"`` when the subset search hit its cap without a verdict
+    (only reachable with pathological non-MDS matrices).
     """
     scan = _scan_chunks(in_file, segment_bytes)
     try:
         _select_decodable_subset(scan)
         ok = True
+    except UndecidedSubsetError:
+        ok = "unknown"
     except ValueError:
         ok = False
     return {

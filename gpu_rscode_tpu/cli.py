@@ -163,12 +163,15 @@ def main(argv: list[str] | None = None) -> int:
     if repair:
         if op == "encode" or auto or conf_file or out_file:
             return _fail("rs: --repair takes only -i (plus tuning flags)")
-        if n_devices:
-            return _fail("rs: --repair does not support --devices (single-device GEMM)")
         op = "repair"
     if scrub:
-        if op == "encode" or auto or conf_file or out_file or n_devices:
+        if op == "encode" or auto or conf_file or out_file:
             return _fail("rs: --scrub takes only -i")
+        if n_devices:
+            return _fail(
+                "rs: --scrub is host-only (CRC reads, no device compute); "
+                "--devices does not apply"
+            )
         op = "scrub"
     if op is None:
         return _fail("rs: choose encode (-e), decode (-d), or --repair -i <file>")
@@ -241,19 +244,10 @@ def main(argv: list[str] | None = None) -> int:
                 ),
             )
             print(json.dumps(report))
-            return 0 if report["decodable"] else 1
+            # "unknown" (subset search capped) is not proven healthy -> 1.
+            return 0 if report["decodable"] is True else 1
         elif op == "repair":
-            rebuilt = api.repair_file(
-                in_file,
-                strategy=strategy,
-                pipeline_depth=max(1, pipeline_depth),
-                **(
-                    {"segment_bytes": kwargs["segment_bytes"]}
-                    if "segment_bytes" in kwargs
-                    else {}
-                ),
-                timer=timer,
-            )
+            rebuilt = api.repair_file(in_file, timer=timer, **kwargs)
             print(
                 f"rebuilt chunks: {rebuilt}" if rebuilt else "archive healthy"
             )

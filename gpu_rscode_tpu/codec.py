@@ -21,6 +21,33 @@ from .ops.gf import get_field
 from .ops.inverse import invert_matrix
 
 
+import functools
+
+
+@functools.lru_cache(maxsize=1)
+def _pallas_failure_types() -> tuple:
+    """Exception types that mean "the fused kernel can't run on this
+    backend" — compile/runtime backend errors and Mosaic lowering failures.
+    Anything else (a shape bug, a TypeError, an assertion) is a programming
+    error and must propagate: silently demoting it to the bitplane path
+    would hide a correctness bug mid-production.
+
+    Computed lazily on the first fused-kernel failure: importing Mosaic
+    lowering internals costs ~0.2 s, which import-time evaluation would
+    charge to every CLI start including host-only paths (--scrub)."""
+    types: list[type] = [jax.errors.JaxRuntimeError, NotImplementedError]
+    try:
+        from jax._src.pallas.mosaic import lowering as _ml
+
+        for _name in ("LoweringException", "FoldingError"):
+            t = getattr(_ml, _name, None)
+            if isinstance(t, type):
+                types.append(t)
+    except Exception:  # mosaic internals moved; backend errors still caught
+        pass
+    return tuple(types)
+
+
 class RSCodec:
     """(n, k) Reed-Solomon codec over GF(2^w).
 
@@ -48,8 +75,9 @@ class RSCodec:
         if strategy == "auto":
             # Mesh runs resolve to bitplane: the sharded body has no
             # Mosaic-failure fallback (a mid-stream kernel failure would
-            # leave partial output files), and stripe sharding is
-            # bitplane-only by construction.
+            # leave partial output files).  Explicit strategy="pallas" works
+            # on meshes — both sharding modes (the stripe mode via the
+            # kernel's pre-parity output) — for callers who accept that.
             if mesh is not None or jax.default_backend() != "tpu":
                 strategy = "bitplane"
             else:
@@ -126,7 +154,11 @@ class RSCodec:
                         jax.block_until_ready(out)
                         self._pallas_checked = True
                     return out
-                except Exception as e:  # noqa: BLE001 — any backend error
+                except Exception as e:
+                    # Broad catch, narrow handling: only known backend /
+                    # Mosaic failure types demote; anything else re-raises.
+                    if not isinstance(e, _pallas_failure_types()):
+                        raise
                     import warnings
 
                     warnings.warn(

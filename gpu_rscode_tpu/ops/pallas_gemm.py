@@ -66,7 +66,9 @@ def _expand_sign(b, w, k, tile):
     return ((bts[:, None, :] << lsh) >> sdt(w - 1)).reshape(k * w, tile)
 
 
-def _kernel(a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand):
+def _kernel(
+    a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand, fold
+):
     tile = b_ref.shape[-1]
     expander = _expand_sign if expand == "sign" else _expand_shift
     planes = expander(b_ref[:], w, k, tile)
@@ -75,6 +77,12 @@ def _kernel(a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand):
         planes.astype(acc_dtype),
         preferred_element_type=jnp.float32 if acc_dtype != jnp.int8 else jnp.int32,
     )
+    if not fold:
+        # Pre-parity mode: emit the raw (p*w, tile) integer bit-plane
+        # accumulators so a cross-device psum can extend the XOR-as-sum
+        # before parity is taken (stripe-sharded GEMM, parallel/sharded.py).
+        o_ref[:] = acc.astype(jnp.int32)
+        return
     # Parity: XOR == sum mod 2.  Holds for the sign formulation too:
     # two's-complement (-n) & 1 == n & 1, and f32->int32 truncation is exact
     # for these small integers.
@@ -87,9 +95,10 @@ def _kernel(a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand):
 
 
 @functools.partial(
-    jax.jit, static_argnames=("w", "tile", "acc_dtype", "interpret", "expand")
+    jax.jit,
+    static_argnames=("w", "tile", "acc_dtype", "interpret", "expand", "fold"),
 )
-def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand):
+def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
     gf = get_field(w)
     p, k = A.shape
     _, m = B.shape
@@ -105,17 +114,21 @@ def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand):
     # 128-aligned for any m; the last tile's overhang is masked by Pallas.
     tile = min(tile, ((m + 127) // 128) * 128)
     grid = (pl.cdiv(m, tile),)
+    out_rows = p if fold else p * w
     return pl.pallas_call(
         functools.partial(
-            _kernel, w=w, k=k, p=p, acc_dtype=acc_dtype, expand=expand
+            _kernel, w=w, k=k, p=p, acc_dtype=acc_dtype, expand=expand,
+            fold=fold,
         ),
-        out_shape=jax.ShapeDtypeStruct((p, m), out_dtype),
+        out_shape=jax.ShapeDtypeStruct(
+            (out_rows, m), out_dtype if fold else jnp.int32
+        ),
         grid=grid,
         in_specs=[
             pl.BlockSpec((p * w, k * w), lambda i: (0, 0)),
             pl.BlockSpec((k, tile), lambda i: (0, i)),
         ],
-        out_specs=pl.BlockSpec((p, tile), lambda i: (0, i)),
+        out_specs=pl.BlockSpec((out_rows, tile), lambda i: (0, i)),
         interpret=interpret,
     )(a_bits, B)
 
@@ -128,8 +141,15 @@ def gf_matmul_pallas(
     acc_dtype=None,
     interpret: bool | None = None,
     expand: str = "shift",
+    fold_parity: bool = True,
 ):
     """``C = A . B`` over GF(2^w) via the fused Pallas kernel.
+
+    ``fold_parity=False`` returns the raw (p*w, m) int32 bit-plane
+    accumulators instead of folded GF elements — the pre-parity form a
+    stripe-sharded caller psums across devices before folding with
+    :func:`..gemm.from_bitplanes` (XOR == total sum mod 2 must be taken
+    AFTER the cross-device reduction).
 
     ``acc_dtype``: matmul input dtype — ``int8`` (int32 accumulation, exact
     for contraction depth < 2^31; 2x MXU rate on v5e) or ``bfloat16`` (f32
@@ -156,4 +176,6 @@ def gf_matmul_pallas(
         tile = DEFAULT_TILE if interpret else TPU_TILE
     if acc_dtype is None:
         acc_dtype = jnp.bfloat16 if interpret else jnp.int8
-    return _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand)
+    return _pallas_matmul(
+        A, B, w, tile, acc_dtype, interpret, expand, fold=fold_parity
+    )

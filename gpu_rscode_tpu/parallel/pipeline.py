@@ -23,10 +23,13 @@ T = TypeVar("T")
 class AsyncWindow(Generic[T]):
     """Bounded window of in-flight async results.
 
-    ``push(tag, future)`` enqueues; once more than ``depth`` are pending the
-    oldest is drained through ``consume(tag, future)`` (which should block
-    on the future — e.g. ``np.asarray`` — and commit the result).  ``flush``
-    drains the rest in order.
+    ``depth`` = the number of segments allowed in flight: after any ``push``
+    returns, at most ``depth`` futures are pending (``-s 2`` overlaps one
+    segment's host work with the previous segment's compute).  ``push(tag,
+    future)`` enqueues; beyond ``depth`` pending the oldest is drained
+    through ``consume(tag, future)`` (which should block on the future —
+    e.g. ``np.asarray`` — and commit the result).  ``flush`` drains the rest
+    in order.
     """
 
     def __init__(self, depth: int, consume: Callable[[Any, T], None]):
@@ -36,7 +39,7 @@ class AsyncWindow(Generic[T]):
 
     def push(self, tag: Any, future: T) -> None:
         self._pending.append((tag, future))
-        while len(self._pending) >= self.depth:
+        while len(self._pending) > self.depth:
             self.consume(*self._pending.pop(0))
 
     def flush(self) -> None:

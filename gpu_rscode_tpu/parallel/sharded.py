@@ -68,21 +68,32 @@ def sharded_gf_matmul(A, B, *, mesh, w=8, strategy="bitplane", stripe_sharded=Fa
         )(A, B)
 
     # Wide stripe: contraction axis sharded.  Integer partials + psum + parity.
-    # This mode is bitplane-only: the partial products MUST stay integer
-    # (pre-parity) so psum can carry the XOR as a sum — a fused-kernel or
-    # table variant would fold parity locally and break the reduction.
-    if strategy != "bitplane":
+    # The partial products MUST stay integer (pre-parity) so psum can carry
+    # the XOR as a sum; both the XLA bitplane path and the fused Pallas
+    # kernel (fold_parity=False) can emit that form.  The table path folds
+    # XOR per element and cannot.
+    if strategy not in ("bitplane", "pallas"):
         import warnings
 
         warnings.warn(
-            f"stripe-sharded GEMM is bitplane-only; ignoring strategy={strategy!r}",
+            "stripe-sharded GEMM needs a pre-parity form (bitplane/pallas); "
+            f"ignoring strategy={strategy!r}",
             stacklevel=2,
         )
+        strategy = "bitplane"
+
+    use_pallas = strategy == "pallas"
 
     def body(a_loc, b_loc):
-        a_bits = _gemm.expand_bitmatrix_jnp(a_loc, w)  # (p*w, k_loc*w)
-        b_bits = _gemm.to_bitplanes(b_loc, w)  # (k_loc*w, m_loc)
-        acc = _gemm._dot_bits(a_bits, b_bits, jnp.int8)  # int32 partials
+        if use_pallas:
+            from ..ops.pallas_gemm import gf_matmul_pallas
+
+            # int32 bit-plane partials straight from VMEM (no refold).
+            acc = gf_matmul_pallas(a_loc, b_loc, w=w, fold_parity=False)
+        else:
+            a_bits = _gemm.expand_bitmatrix_jnp(a_loc, w)  # (p*w, k_loc*w)
+            b_bits = _gemm.to_bitplanes(b_loc, w)  # (k_loc*w, m_loc)
+            acc = _gemm._dot_bits(a_bits, b_bits, jnp.int8)  # int32 partials
         acc = jax.lax.psum(acc, STRIPE)  # XOR = (sum over devices) mod 2
         return _gemm.from_bitplanes(acc, w, dtype=out_dtype)
 

@@ -429,6 +429,56 @@ def test_auto_strategy_on_mesh_resolves_bitplane():
     assert RSCodec(4, 2, strategy="auto", mesh=mesh).strategy == "bitplane"
 
 
+def test_pallas_fallback_on_backend_error(monkeypatch):
+    """A backend/Mosaic failure in the fused kernel demotes to bitplane with
+    a warning; the result is still bit-exact."""
+    import warnings
+
+    import jax
+
+    from gpu_rscode_tpu import codec as codec_mod
+    from gpu_rscode_tpu.codec import RSCodec
+
+    c = RSCodec(4, 2, strategy="pallas")
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+    expected = c.gf.matmul(c.parity_block, data)
+
+    real = codec_mod.gf_matmul_jit
+
+    def boom(A, B, w=8, strategy="bitplane"):
+        if strategy == "pallas":
+            raise jax.errors.JaxRuntimeError("MOSAIC: backend exploded")
+        return real(A, B, w=w, strategy=strategy)
+
+    monkeypatch.setattr(codec_mod, "gf_matmul_jit", boom)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = np.asarray(c.encode(data))
+    assert c.strategy == "bitplane"  # demoted
+    assert any("falling back" in str(w.message) for w in caught)
+    np.testing.assert_array_equal(out, expected)
+
+
+def test_pallas_fallback_does_not_swallow_program_errors(monkeypatch):
+    """A NON-backend exception inside the fused-kernel dispatch is a
+    programming error and must propagate, not silently demote the strategy
+    (round-1 review: broad except could hide correctness bugs)."""
+    from gpu_rscode_tpu import codec as codec_mod
+    from gpu_rscode_tpu.codec import RSCodec
+
+    c = RSCodec(4, 2, strategy="pallas")
+    data = np.zeros((4, 512), dtype=np.uint8)
+
+    def boom(A, B, w=8, strategy="bitplane"):
+        raise ValueError("shape bug")
+
+    monkeypatch.setattr(codec_mod, "gf_matmul_jit", boom)
+    with pytest.raises(ValueError, match="shape bug"):
+        c.encode(data)
+    assert c.strategy == "pallas"  # not demoted
+
+
 # ----- chunk repair ---------------------------------------------------------
 
 
@@ -512,6 +562,50 @@ def test_scan_reports_truncated_as_corrupt(tmp_path):
     assert open(victim, "rb").read() == golden
 
 
+def test_subset_search_capped_vs_exhausted():
+    """The subset search must distinguish 'every combination tried, none
+    inverts' (ValueError) from 'cap hit, verdict unknown'
+    (UndecidedSubsetError) — an operator must not read a capped search as
+    proof the archive is unrecoverable."""
+    from gpu_rscode_tpu.api import UndecidedSubsetError, _ChunkScan, _select_decodable_subset
+
+    def scan_with(healthy, k):
+        n = len(healthy)
+        mat = np.zeros((n + k, k), dtype=np.uint8)  # all-singular (non-MDS)
+        return _ChunkScan(
+            "f", 100, n + k - k, k, mat, 8, {}, 10, list(healthy), {}
+        )
+
+    # C(13,3) = 286 > 100 -> capped
+    with pytest.raises(UndecidedSubsetError, match="not proven"):
+        _select_decodable_subset(scan_with(range(13), 3))
+    # C(4,3) = 4 < 100 -> exhausted, plain ValueError
+    with pytest.raises(ValueError, match="among healthy"):
+        try:
+            _select_decodable_subset(scan_with(range(4), 3))
+        except UndecidedSubsetError:
+            pytest.fail("exhausted search misreported as capped")
+
+
+def test_scan_file_decodable_unknown_when_capped(tmp_path, monkeypatch):
+    """scan_file surfaces the capped case structurally: decodable='unknown',
+    and the scrub CLI exits 1 (not proven healthy)."""
+    from gpu_rscode_tpu import api as api_mod
+    from gpu_rscode_tpu import cli
+    from gpu_rscode_tpu.api import UndecidedSubsetError
+
+    path = _mkfile(tmp_path, 4_000, seed=66)
+    api.encode_file(path, 4, 2)
+
+    def capped(scan):
+        raise UndecidedSubsetError("cap hit")
+
+    monkeypatch.setattr(api_mod, "_select_decodable_subset", capped)
+    report = api.scan_file(path)
+    assert report["decodable"] == "unknown"
+    assert cli.main(["--scrub", "-i", path]) == 1
+
+
 # ----- mesh-sharded file layer ----------------------------------------------
 
 
@@ -536,16 +630,65 @@ def test_mesh_sharded_file_roundtrip_matches_single_device(tmp_path):
     assert open(out, "rb").read() == orig
 
 
-def test_stripe_sharded_file_roundtrip(tmp_path):
+@pytest.mark.parametrize("strategy", ["auto", "pallas"])
+def test_stripe_sharded_file_roundtrip(tmp_path, strategy):
     """Wide-stripe mode end-to-end at the file layer: the k axis sharded
-    over 2 devices, psum carrying the XOR accumulation."""
+    over 2 devices, psum carrying the XOR accumulation.  strategy='pallas'
+    drives the fused kernel's pre-parity output through the file API."""
     from gpu_rscode_tpu.parallel.mesh import make_mesh
 
     path = _mkfile(tmp_path, 33_000, seed=82)
     orig = open(path, "rb").read()
     mesh = make_mesh(8, stripe=2)
-    api.encode_file(path, 4, 2, mesh=mesh, stripe_sharded=True)
+    api.encode_file(path, 4, 2, mesh=mesh, stripe_sharded=True, strategy=strategy)
     conf = make_conf(6, 4, path)
     out = str(tmp_path / "o")
-    api.decode_file(path, conf, out, mesh=mesh, stripe_sharded=True)
+    api.decode_file(path, conf, out, mesh=mesh, stripe_sharded=True, strategy=strategy)
     assert open(out, "rb").read() == orig
+
+
+@pytest.mark.parametrize("stripe", [1, 2])
+def test_mesh_repair_byte_identical(tmp_path, stripe):
+    """Archive repair fans out over the mesh (the reference's multi-GPU
+    decode analog, decode.cu:335-378): rebuilt chunks must be byte-identical
+    to the single-device goldens, stripe-sharded mode included."""
+    from gpu_rscode_tpu.parallel.mesh import make_mesh
+
+    path = _mkfile(tmp_path, 41_003, seed=83)
+    api.encode_file(path, 4, 2, checksums=True)
+    golden = {i: open(chunk_file_name(path, i), "rb").read() for i in range(6)}
+    os.remove(chunk_file_name(path, 4))  # parity lost
+    victim = chunk_file_name(path, 0)  # native corrupted
+    data = bytearray(golden[0])
+    data[100] ^= 0x5A
+    open(victim, "wb").write(bytes(data))
+
+    mesh = make_mesh(8, stripe=stripe)
+    rebuilt = api.repair_file(
+        path, mesh=mesh, stripe_sharded=stripe > 1
+    )
+    assert rebuilt == [0, 4]
+    for i in range(6):
+        assert open(chunk_file_name(path, i), "rb").read() == golden[i], i
+
+
+def test_mesh_auto_decode_roundtrip(tmp_path):
+    """auto-decode with the GEMM sharded over the mesh: deleted + corrupt
+    chunks excluded, file recovered bit-exactly."""
+    from gpu_rscode_tpu.parallel.mesh import make_mesh
+
+    path = _mkfile(tmp_path, 52_000, seed=84)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2, checksums=True)
+    os.remove(chunk_file_name(path, 1))
+    victim = chunk_file_name(path, 3)
+    raw = bytearray(open(victim, "rb").read())
+    raw[7] ^= 0xFF
+    open(victim, "wb").write(bytes(raw))
+
+    out = str(tmp_path / "o")
+    mesh = make_mesh(8)
+    api.auto_decode_file(path, out, mesh=mesh)
+    assert open(out, "rb").read() == orig
+    chosen = open(path + ".auto.conf").read()
+    assert "_1_" not in chosen and "_3_" not in chosen

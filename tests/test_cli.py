@@ -121,3 +121,35 @@ def test_cli_devices_roundtrip(tmp_path):
         ["-d", "-i", path, "-c", conf, "-o", out, "--devices", "8", "--quiet"]
     ) == 0
     assert open(out, "rb").read() == data
+
+
+def test_cli_repair_on_mesh(tmp_path):
+    """--repair accepts --devices now (round-1 VERDICT: lift the
+    single-device restriction on the maintenance paths)."""
+    import numpy as np
+
+    from gpu_rscode_tpu import cli
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    path = str(tmp_path / "f.bin")
+    data = np.random.default_rng(73).integers(0, 256, 8888, dtype=np.uint8).tobytes()
+    open(path, "wb").write(data)
+    assert cli.main(
+        ["-k", "4", "-n", "6", "-e", path, "--checksum", "--quiet"]
+    ) == 0
+    import os as _os
+
+    golden = open(chunk_file_name(path, 5), "rb").read()
+    _os.remove(chunk_file_name(path, 5))
+    assert cli.main(
+        ["--repair", "-i", path, "--devices", "8", "--quiet"]
+    ) == 0
+    assert open(chunk_file_name(path, 5), "rb").read() == golden
+
+
+def test_cli_scrub_rejects_devices(tmp_path):
+    """--scrub is host-only; --devices must be rejected with a clear error,
+    not silently ignored."""
+    from gpu_rscode_tpu import cli
+
+    assert cli.main(["--scrub", "-i", "whatever", "--devices", "8"]) == 2

@@ -10,14 +10,17 @@ from gpu_rscode_tpu.tools.make_conf import make_conf
 
 
 def test_window_orders_and_bounds():
+    """depth = segments allowed in flight: depth 2 keeps two futures pending
+    and drains the oldest only when a third arrives (round-1 review fixed a
+    depth-vs-doc off-by-one; this pins the documented semantics)."""
     drained = []
     w = AsyncWindow(2, lambda tag, fut: drained.append((tag, fut)))
     w.push(0, "a")
     assert drained == []
     w.push(1, "b")
-    assert drained == [(0, "a")]  # oldest drained once depth reached
+    assert drained == []  # exactly depth in flight — no drain yet
     w.push(2, "c")
-    assert drained == [(0, "a"), (1, "b")]
+    assert drained == [(0, "a")]  # oldest drained once depth exceeded
     w.flush()
     assert drained == [(0, "a"), (1, "b"), (2, "c")]
 

@@ -34,27 +34,51 @@ def test_cols_sharding_matches_oracle(strategy):
     np.testing.assert_array_equal(got, want)
 
 
+@pytest.mark.parametrize("strategy", ["bitplane", "pallas"])
 @pytest.mark.parametrize("stripe,k", [(2, 8), (4, 32), (8, 128)])
-def test_stripe_sharding_wide_k(stripe, k):
-    """Wide-stripe configs: contraction axis sharded, psum over ICI."""
+def test_stripe_sharding_wide_k(stripe, k, strategy):
+    """Wide-stripe configs: contraction axis sharded, psum over ICI.  Both
+    pre-parity forms — XLA bitplane and the fused kernel's fold_parity=False
+    output — must agree with the oracle."""
     mesh = make_mesh(8, stripe=stripe)
     A, B, want = _case(4, k, (8 // stripe) * 256, seed=k)
     Bd = put_sharded(B, mesh, stripe_sharded=True)
     got = np.asarray(
-        sharded_gf_matmul(A, Bd, mesh=mesh, stripe_sharded=True)
+        sharded_gf_matmul(
+            A, Bd, mesh=mesh, stripe_sharded=True, strategy=strategy
+        )
     )
     np.testing.assert_array_equal(got, want)
 
 
-def test_wide_stripe_k128_baseline_config():
+@pytest.mark.parametrize("strategy", ["bitplane", "pallas"])
+def test_wide_stripe_k128_baseline_config(strategy):
     """BASELINE config 4: (k=128, n=144) wide stripe over 8 devices."""
     mesh = make_mesh(8, stripe=8)
     A, B, want = _case(16, 128, 256, seed=99)
     Bd = put_sharded(B, mesh, stripe_sharded=True)
     got = np.asarray(
-        sharded_gf_matmul(A, Bd, mesh=mesh, stripe_sharded=True)
+        sharded_gf_matmul(
+            A, Bd, mesh=mesh, stripe_sharded=True, strategy=strategy
+        )
     )
     np.testing.assert_array_equal(got, want)
+
+
+def test_pallas_preparity_matches_bitplane_partials_fold():
+    """Single-device sanity: the kernel's fold_parity=False output folds to
+    exactly the folded kernel's result (pins the pre-parity contract the
+    stripe psum relies on)."""
+    from gpu_rscode_tpu.ops.gemm import from_bitplanes
+    from gpu_rscode_tpu.ops.pallas_gemm import gf_matmul_pallas
+
+    A, B, want = _case(4, 10, 1024, seed=3)
+    folded = np.asarray(gf_matmul_pallas(A, B))
+    partials = gf_matmul_pallas(A, B, fold_parity=False)
+    assert partials.dtype == np.int32 and partials.shape == (4 * 8, 1024)
+    refolded = np.asarray(from_bitplanes(partials, 8))
+    np.testing.assert_array_equal(refolded, folded)
+    np.testing.assert_array_equal(refolded, want)
 
 
 def test_decode_through_sharded_gemm():
