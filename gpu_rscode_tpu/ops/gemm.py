@@ -57,6 +57,22 @@ def expand_bitmatrix_jnp(A: jnp.ndarray, w: int = 8) -> jnp.ndarray:
     return blocks.transpose(0, 2, 1, 3).reshape(p * w, k * w)
 
 
+@functools.lru_cache(maxsize=None)
+def _np_nibble_mats(w: int):
+    return get_field(w).nibble_mats  # (2^w, w, 32) uint8
+
+
+def expand_nibblematrix_jnp(A: jnp.ndarray, w: int = 8) -> jnp.ndarray:
+    """(p, k) GF(2^8) matrix -> (p*w, k*32) one-hot-nibble operator: block
+    (pi, ki) maps ``[one_hot(hi); one_hot(lo)]`` of data byte ki to the bit
+    planes of ``A[pi, ki] * byte``.  Pairs with the kernel's "nibble"
+    expansion (pallas_gemm)."""
+    mats = jnp.asarray(_np_nibble_mats(w))
+    p, k = A.shape
+    blocks = mats[A.astype(jnp.int32)]  # (p, k, w, 32)
+    return blocks.transpose(0, 2, 1, 3).reshape(p * w, k * 32)
+
+
 def to_bitplanes(B: jnp.ndarray, w: int = 8) -> jnp.ndarray:
     """(k, m) GF elements -> (k*w, m) 0/1 planes (bit 0 = LSB first).
 

@@ -119,6 +119,7 @@ class GaloisField:
         # matrix M_v with bits(v * b) = M_v @ bits(b) mod 2.  Column j of M_v
         # is the bit vector of v * (1 << j).  Built lazily for w=16.
         self._bitmats: np.ndarray | None = None
+        self._nibble_mats: np.ndarray | None = None
 
     # ----- scalar / vectorised field ops -------------------------------------
 
@@ -194,6 +195,31 @@ class GaloisField:
                 (prods[:, None, :].astype(np.int64) >> shifts[None, :, None]) & 1
             ).astype(np.uint8)
         return self._bitmats
+
+    @property
+    def nibble_mats(self) -> np.ndarray:
+        """(2^w, w, 32) uint8 — one-hot-nibble multiply operator blocks.
+
+        ``nibble_mats[c, s, v] = bit s of c * val(v)`` with ``val(v) = v<<4``
+        for v < 16 (high nibble) and ``val(v) = v - 16`` for v >= 16 (low).
+        Since ``b = (hi<<4) ^ lo``, stacking ``one_hot(hi)`` over
+        ``one_hot(lo)`` gives ``bits(c*b) = nibble_mats[c] @ stack mod 2`` —
+        the MXU-side analog of the reference's GF(16) nibble-table strategy
+        (gf16.h, design.tex:190-209, and the 4 KB half-byte tables of
+        cpu-rs-double.c:52-55).  w=8 only.
+        """
+        if self.w != 8:
+            raise ValueError("nibble operator is defined for w=8 only")
+        if self._nibble_mats is None:
+            vals = np.concatenate(
+                [np.arange(16, dtype=np.int64) << 4, np.arange(16, dtype=np.int64)]
+            )
+            prods = self.mul(np.arange(256, dtype=np.int64)[:, None], vals[None, :])
+            shifts = np.arange(8, dtype=np.int64)
+            self._nibble_mats = (
+                (prods[:, None, :].astype(np.int64) >> shifts[None, :, None]) & 1
+            ).astype(np.uint8)
+        return self._nibble_mats
 
     def expand_bitmatrix(self, A: np.ndarray) -> np.ndarray:
         """Expand a (p, k) GF coefficient matrix to its (p*w, k*w) GF(2)

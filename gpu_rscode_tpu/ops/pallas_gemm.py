@@ -66,11 +66,27 @@ def _expand_sign(b, w, k, tile):
     return ((bts[:, None, :] << lsh) >> sdt(w - 1)).reshape(k * w, tile)
 
 
+def _expand_nibble(b, w, k, tile):
+    # One-hot of the high and low nibbles, int8 lanes throughout: 32 rows
+    # per data byte, selecting columns of the (p*w, k*32) nibble operator
+    # (gf.nibble_mats).  Trades 4x MXU work (affordable: the kernel runs at
+    # a small fraction of int8 peak) for compare-based expansion on the VPU.
+    v = jax.lax.broadcasted_iota(jnp.uint8, (1, 16, 1), 1)
+    hi = (b >> 4)[:, None, :]
+    lo = (b & 0xF)[:, None, :]
+    planes = jnp.concatenate([hi == v, lo == v], axis=1)  # (k, 32, tile)
+    return planes.reshape(k * 32, tile)
+
+
 def _kernel(
     a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand, fold
 ):
     tile = b_ref.shape[-1]
-    expander = _expand_sign if expand == "sign" else _expand_shift
+    expander = {
+        "sign": _expand_sign,
+        "nibble": _expand_nibble,
+        "shift": _expand_shift,
+    }[expand]
     planes = expander(b_ref[:], w, k, tile)
     acc = jnp.dot(
         a_ref[:].astype(acc_dtype),
