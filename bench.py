@@ -94,7 +94,8 @@ def _init_backend():
     tunnel failure mode blocks forever.  Each candidate backend is first
     probed in a subprocess with a timeout; only a probe that comes back
     healthy is initialised in-process.  Falls back to forced cpu with the
-    axon factory deregistered.  Returns (jax, backend_name); the bench
+    axon factory deregistered (a later TPU second chance happens at emit
+    time, see _second_chance_tpu).  Returns (jax, backend_name); the bench
     ALWAYS emits its JSON line with whatever backend this lands on.
     """
     import os
@@ -129,6 +130,10 @@ def _init_backend():
             jax.config.update("jax_platforms", "")
             jax.devices()
             return jax, jax.default_backend()
+    if os.environ.get("RS_BENCH_NO_FALLBACK"):
+        # The second-chance child must never report a CPU number (its parent
+        # already holds one) — fail fast instead.
+        raise SystemExit("no TPU backend and RS_BENCH_NO_FALLBACK set")
     # Last resort: forced cpu, axon factory removed so nothing can dial the
     # tunnel again (shared landmine-defusal helper, see _axon_guard.py).
     from _axon_guard import defuse_axon
@@ -137,6 +142,78 @@ def _init_backend():
     jax.devices()  # if even cpu fails there is nothing to salvage
     print("# TPU backend unavailable; benching on cpu", file=sys.stderr)
     return jax, jax.default_backend()
+
+
+def _second_chance_tpu() -> bool:
+    """One more try at the hardware before settling for a CPU line.
+
+    Round-2 postmortem: the tunnel hung once at t=0 and the bench shipped a
+    CPU number even though the tunnel may have recovered minutes later while
+    the CPU strategies ran.  With the CPU result safely in hand, re-probe;
+    if healthy, re-run the whole bench in a child process (fresh interpreter
+    — this one's jax is pinned to the defused cpu backend) and forward its
+    TPU JSON line as OUR single output line.  Returns True iff that
+    happened.  The child sets RS_BENCH_NO_FALLBACK so it can never recurse
+    into a second CPU measurement.
+
+    Time-bounded so the held CPU line cannot be lost to a driver timeout
+    (the "ALWAYS emits its JSON line" contract): skipped entirely when the
+    bench has already burned >180 s, probe 60 s, child 300 s — worst case
+    adds ~6 min to a run that is otherwise done.
+    """
+    import os
+    import subprocess
+
+    if _time_mod.time() - _T0 > 180:
+        _mark("no time budget for a TPU second chance; keeping cpu line")
+        return False
+    # The fallback path pinned JAX_PLATFORMS=cpu in os.environ — the probe
+    # child must not inherit that or it can only ever answer "cpu".
+    probe_env = dict(os.environ)
+    probe_env.pop("JAX_PLATFORMS", None)
+    p = subprocess.Popen(
+        [sys.executable, "-c",
+         "import jax; print(jax.devices()[0].platform.lower())"],
+        env=probe_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True,
+    )
+    try:
+        out, _err = p.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        p.terminate()
+        try:
+            p.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            p.kill()
+        _mark("second-chance probe hung; keeping cpu line")
+        return False
+    platform = out.strip().splitlines()[-1] if (p.returncode == 0 and out.strip()) else ""
+    if platform != "tpu":
+        _mark(f"second-chance probe saw {platform or 'nothing'}; keeping cpu line")
+        return False
+    _mark("tunnel recovered (tpu devices); re-running on hardware")
+    env = dict(os.environ)
+    env["RS_BENCH_NO_FALLBACK"] = "1"
+    env.pop("JAX_PLATFORMS", None)
+    try:
+        run = subprocess.run(
+            [sys.executable, __file__],
+            env=env, capture_output=True, text=True, timeout=300,
+        )
+    except subprocess.TimeoutExpired:
+        _mark("second-chance run timed out; keeping cpu line")
+        return False
+    if run.returncode == 0:
+        for line in run.stdout.splitlines():
+            if line.startswith("{") and "_tpu" in line.split(",")[0]:
+                try:
+                    if json.loads(line).get("value", 0) > 0:
+                        print(line)
+                        return True
+                except ValueError:
+                    pass
+    _mark(f"second-chance run rc={run.returncode} had no good TPU line; keeping cpu line")
+    return False
 
 
 def _verify(small_fn, oracle_slice):
@@ -254,6 +331,8 @@ def main() -> None:
     except Exception as e:
         detail["decode"] = f"failed: {type(e).__name__}"
     _mark("done")
+    if backend != "tpu" and _second_chance_tpu():
+        return  # the forwarded TPU line is the bench's single output line
     _emit(backend, best[1], {"strategy": best[0], **detail})
 
 
@@ -262,6 +341,12 @@ if __name__ == "__main__":
         main()
     except SystemExit:
         raise
-    except BaseException as e:  # noqa: BLE001 — the JSON line must always appear
+    except KeyboardInterrupt:
+        # An operator interrupt is not a bench failure: emit the always-there
+        # JSON line for any log scraper, then let the interrupt status
+        # propagate (ADVICE r2).
+        _emit("error", 0.0, {"error": "KeyboardInterrupt"})
+        raise
+    except Exception as e:  # noqa: BLE001 — the JSON line must always appear
         _emit("error", 0.0, {"error": f"{type(e).__name__}: {e}"[:300]})
         sys.exit(1)

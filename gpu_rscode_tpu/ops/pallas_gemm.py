@@ -34,6 +34,11 @@ expansion (DMA floor ~268 GB/s vs ~63 GB/s end-to-end), motivating "sign":
   staying in w-bit lanes (4x VPU packing for w=8).  -1 === 1 (mod 2), so
   the parity of the integer accumulator — all the refold reads — is
   unchanged.
+* ``"nibble"`` (w=8) — one-hot of the high/low nibbles (32 rows per data
+  byte) against the (p*w, k*32) one-hot-nibble operator (gf.nibble_mats):
+  compare-based VPU expansion, 4x the MXU contraction depth.  The MXU
+  analog of the reference's fastest kernel — the GF(16) nibble-table
+  branch (design.tex:485 9.12 ms vs 160.5 ms; gf16.h:1-22).
 """
 
 from __future__ import annotations
@@ -118,13 +123,21 @@ def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
     gf = get_field(w)
     p, k = A.shape
     _, m = B.shape
-    # Expand the coefficient matrix to its (p*w, k*w) GF(2) operator on the
-    # host side of the graph (tiny; XLA folds it when A is a constant).
-    from .gemm import expand_bitmatrix_jnp
+    # Expand the coefficient matrix to its GF(2) operator on the host side of
+    # the graph (tiny; XLA folds it when A is a constant).  The bit-plane
+    # expansions pair with the (p*w, k*w) bit operator; the nibble expansion
+    # pairs with the (p*w, k*32) one-hot-nibble operator (the MXU analog of
+    # the reference's GF(16) nibble-table strategy, gf16.h:1-22,
+    # cpu-rs-double.c:52-55).
+    from .gemm import expand_bitmatrix_jnp, expand_nibblematrix_jnp
 
-    a_bits = expand_bitmatrix_jnp(A, w).astype(
-        jnp.int8 if acc_dtype == jnp.int8 else acc_dtype
-    )
+    if expand == "nibble":
+        a_op = expand_nibblematrix_jnp(A, w)
+        a_cols = k * 32
+    else:
+        a_op = expand_bitmatrix_jnp(A, w)
+        a_cols = k * w
+    a_bits = a_op.astype(jnp.int8 if acc_dtype == jnp.int8 else acc_dtype)
     out_dtype = jnp.uint8 if gf.dtype == np.uint8 else jnp.uint16
     # Clamp to m rounded up to the lane width so the block shape stays
     # 128-aligned for any m; the last tile's overhang is masked by Pallas.
@@ -141,7 +154,7 @@ def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
         ),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((p * w, k * w), lambda i: (0, 0)),
+            pl.BlockSpec((p * w, a_cols), lambda i: (0, 0)),
             pl.BlockSpec((k, tile), lambda i: (0, i)),
         ],
         out_specs=pl.BlockSpec((out_rows, tile), lambda i: (0, i)),
@@ -172,17 +185,23 @@ def gf_matmul_pallas(
     accumulation, exact for depth < 2^24).  Both bit-verified; defaults are
     the measured-best per backend (v5e sweep 2026-07: int8 @ tile 16384 =
     61.7 GB/s, bf16 @ 2048 = 42.1 GB/s).
-    ``expand``: bit-expansion formulation, "shift" (default) or "sign" (see
-    module docstring).
+    ``expand``: data-expansion formulation — "shift" (default), "sign", or
+    "nibble" (w=8 only: one-hot nibble planes against the (p*w, k*32)
+    operator; see module docstring).
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
-    if expand not in ("shift", "sign"):
+    if expand not in ("shift", "sign", "nibble"):
         raise ValueError(f"unknown expand {expand!r}")
     if expand == "sign" and w not in (8, 16):
         raise ValueError(
             f"expand='sign' needs a lane-width field (w=8 or 16), got w={w}; "
             "use expand='shift' for other widths"
+        )
+    if expand == "nibble" and w != 8:
+        raise ValueError(
+            f"expand='nibble' is a GF(2^8) strategy (two one-hot nibbles per "
+            f"byte), got w={w}"
         )
     A = jnp.asarray(A)
     B = jnp.asarray(B)

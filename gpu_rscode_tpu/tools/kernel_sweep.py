@@ -27,7 +27,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 
 from ..models.vandermonde import vandermonde_matrix
-from ..ops.gemm import expand_bitmatrix_jnp
+from ..ops.gemm import expand_bitmatrix_jnp, expand_nibblematrix_jnp
 from .. import native
 from ._bench_timing import time_device_fn as _time
 
@@ -75,9 +75,9 @@ def _body_dma(a_ref, b_ref, o_ref, *, w, k, p):
     o_ref[:] = b_ref[:p, :]
 
 
-# The sign expander is the production one — the sweep must benchmark the
-# exact formulation that ships.
-from ..ops.pallas_gemm import _expand_sign
+# The sign/nibble expanders are the production ones — the sweep must
+# benchmark the exact formulations that ship.
+from ..ops.pallas_gemm import _expand_nibble, _expand_sign
 
 
 def _body_sign(a_ref, b_ref, o_ref, *, w, k, p):
@@ -119,6 +119,21 @@ def _body_signf(a_ref, b_ref, o_ref, *, w, k, p):
     o_ref[:] = out.astype(o_ref.dtype)
 
 
+def _body_nibble(a_ref, b_ref, o_ref, *, w, k, p):
+    """One-hot nibble expansion against the (p*w, k*32) operator — the MXU
+    analog of the reference's GF(16) nibble-table kernel (design.tex:485)."""
+    tile = b_ref.shape[-1]
+    planes = _expand_nibble(b_ref[:], w, k, tile)
+    acc = jnp.dot(
+        a_ref[:], planes.astype(jnp.int8), preferred_element_type=jnp.int32
+    )
+    bits = acc & 1
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    o_ref[:] = jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1).astype(
+        o_ref.dtype
+    )
+
+
 BODIES = {
     "base": _body_base,
     "cmp": _body_cmp,
@@ -126,7 +141,12 @@ BODIES = {
     "sign": _body_sign,
     "signc": _body_signc,
     "signf": _body_signf,
+    "nibble": _body_nibble,
 }
+
+# Bodies whose coefficient operator is the (p*w, k*32) one-hot-nibble form
+# instead of the (p*w, k*w) bit operator.
+NIBBLE_BODIES = {"nibble"}
 
 
 def make_fn(name, A_bits, B, tile, pinned_input=False):
@@ -135,6 +155,7 @@ def make_fn(name, A_bits, B, tile, pinned_input=False):
     tile = min(tile, m)
     body = functools.partial(BODIES[name], w=w, k=k, p=p)
     b_map = (lambda i: (0, 0)) if pinned_input else (lambda i: (0, i))
+    a_cols = k * 32 if name in NIBBLE_BODIES else k * w
 
     @jax.jit
     def run(A_bits, B):
@@ -143,7 +164,7 @@ def make_fn(name, A_bits, B, tile, pinned_input=False):
             out_shape=jax.ShapeDtypeStruct((p, m), jnp.uint8),
             grid=(pl.cdiv(m, tile),),
             in_specs=[
-                pl.BlockSpec((p * w, k * w), lambda i: (0, 0)),
+                pl.BlockSpec((p * w, a_cols), lambda i: (0, 0)),
                 pl.BlockSpec((k, tile), b_map),
             ],
             out_specs=pl.BlockSpec((p, tile), lambda i: (0, i)),
@@ -161,7 +182,11 @@ def main():
     )
     args = ap.parse_args()
 
-    assert jax.default_backend() == "tpu", "sweep is for real hardware"
+    # The tunnel backend may self-report as "axon" while its devices are real
+    # TPU chips — gate on the device platform, not the registration name.
+    assert any(
+        d.platform.lower() == "tpu" for d in jax.devices()
+    ) or jax.default_backend() == "tpu", "sweep is for real hardware"
     m = args.mb * 1024 * 1024 // K
     m = (m // 512) * 512
     A = vandermonde_matrix(P, K)
@@ -170,15 +195,18 @@ def main():
     A_bits = jax.device_put(
         np.asarray(expand_bitmatrix_jnp(jnp.asarray(A), W)).astype(np.int8)
     )
+    A_nib = jax.device_put(
+        np.asarray(expand_nibblematrix_jnp(jnp.asarray(A), W)).astype(np.int8)
+    )
     Bd = jax.device_put(B_host)
     oracle = native.gemm(A, B_host[:, :4096])
     data_bytes = K * m
 
     tiles = [int(t) for t in args.tiles.split(",")]
     results = {}
-    for name in ("base", "cmp", "sign", "signc", "signf"):
+    for name in ("base", "cmp", "sign", "signc", "signf", "nibble"):
         for tile in tiles:
-            fn = make_fn(name, A_bits, Bd, tile)
+            fn = make_fn(name, A_nib if name in NIBBLE_BODIES else A_bits, Bd, tile)
             try:
                 got = np.asarray(fn()[:, :4096])
                 if np.array_equal(got, oracle):

@@ -20,4 +20,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from _axon_guard import defuse_axon  # noqa: E402
 
-defuse_axon(8)
+# override_count=False: an externally supplied
+# --xla_force_host_platform_device_count (a wider-mesh run) must win over
+# the 8-device default (ADVICE r2).
+defuse_axon(8, override_count=False)

@@ -460,6 +460,20 @@ def test_pallas_fallback_on_backend_error(monkeypatch):
     np.testing.assert_array_equal(out, expected)
 
 
+def test_pallas_failure_types_include_mosaic_lowering():
+    """The fallback guard names private Mosaic lowering types
+    (jax._src.pallas.mosaic.lowering); a jax upgrade that relocates them
+    would silently narrow the guard to JaxRuntimeError/NotImplementedError.
+    Pin their resolution here so the narrowing shows up in CI (ADVICE r2)."""
+    from gpu_rscode_tpu.codec import _pallas_failure_types
+
+    types = _pallas_failure_types()
+    assert len(types) > 2, (
+        "Mosaic lowering exception types no longer resolve — update "
+        "codec._pallas_failure_types for this jax version"
+    )
+
+
 def test_pallas_fallback_does_not_swallow_program_errors(monkeypatch):
     """A NON-backend exception inside the fused-kernel dispatch is a
     programming error and must propagate, not silently demote the strategy

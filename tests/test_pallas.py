@@ -68,15 +68,41 @@ def test_pallas_file_roundtrip(tmp_path):
     assert open(out, "rb").read() == data
 
 
-@pytest.mark.parametrize("expand", ["shift", "sign"])
+@pytest.mark.parametrize("expand", ["shift", "sign", "nibble"])
 def test_pallas_expand_modes(expand):
-    """Both bit-expansion formulations are bit-exact (the sign trick's
-    {0,-1} planes preserve accumulator parity)."""
+    """All data-expansion formulations are bit-exact (the sign trick's
+    {0,-1} planes preserve accumulator parity; the nibble one-hots select
+    columns of the (p*w, k*32) operator)."""
     gf = get_field(8)
     rng = np.random.default_rng(21)
     A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
     B = rng.integers(0, 256, size=(10, 1000), dtype=np.uint8)
     got = np.asarray(gf_matmul_pallas(A, B, expand=expand))
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
+def test_pallas_nibble_rejects_wide_field():
+    """The nibble strategy is GF(2^8)-specific: two one-hot nibbles per byte."""
+    rng = np.random.default_rng(24)
+    A = rng.integers(0, 1 << 16, size=(2, 3), dtype=np.uint16)
+    B = rng.integers(0, 1 << 16, size=(3, 256), dtype=np.uint16)
+    with pytest.raises(ValueError, match="nibble"):
+        gf_matmul_pallas(A, B, w=16, expand="nibble")
+
+
+@pytest.mark.parametrize("expand", ["shift", "sign", "nibble"])
+def test_pallas_preparity_expand_modes(expand):
+    """fold_parity=False (the stripe-sharded pre-psum form) under every
+    expansion: folding the raw accumulators must equal the oracle."""
+    from gpu_rscode_tpu.ops.gemm import from_bitplanes
+
+    gf = get_field(8)
+    rng = np.random.default_rng(25)
+    A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(10, 640), dtype=np.uint8)
+    acc = gf_matmul_pallas(A, B, expand=expand, fold_parity=False)
+    assert acc.shape == (4 * 8, 640)
+    got = np.asarray(from_bitplanes(acc, 8))
     np.testing.assert_array_equal(got, gf.matmul(A, B))
 
 
@@ -92,7 +118,7 @@ def test_pallas_wide_symbols(expand):
     np.testing.assert_array_equal(got, gf.matmul(A, B))
 
 
-@pytest.mark.parametrize("expand", ["shift", "sign"])
+@pytest.mark.parametrize("expand", ["shift", "sign", "nibble"])
 def test_pallas_sign_int8_acc(expand):
     """int8 accumulation path (the TPU default) under both expansions."""
     import jax.numpy as jnp

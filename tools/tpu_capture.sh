@@ -1,0 +1,51 @@
+#!/bin/bash
+# TPU capture loop — round-3 response to VERDICT item 1 ("treat the tunnel as
+# intermittent, not binary").  Probes the axon tunnel every ~2 min; the moment
+# a probe comes back healthy it captures bench.py and the kernel sweep into
+# timestamped files under bench_captures/ and exits 0 so the operator can
+# commit them.  Exits 2 on deadline without a healthy probe.
+#
+# Usage: tools/tpu_capture.sh [max_seconds] [--bench-only]
+set -u
+cd /root/repo
+mkdir -p bench_captures
+MAX=36000
+MODE=full
+for arg in "$@"; do
+  case "$arg" in
+    --bench-only) MODE=--bench-only ;;
+    *[!0-9]*) echo "unknown arg: $arg" >&2; exit 64 ;;
+    *) MAX=$arg ;;
+  esac
+done
+START=$SECONDS
+ATTEMPT=0
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 90 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    ts=$(date -u +%Y%m%dT%H%M%SZ)
+    echo "# tunnel healthy at $ts; capturing" >&2
+    timeout 1200 python bench.py \
+      > "bench_captures/bench_${ts}.json" 2> "bench_captures/bench_${ts}.log"
+    brc=$?
+    if [ $brc -eq 0 ] && grep -q '_tpu"' "bench_captures/bench_${ts}.json"; then
+      echo "# bench capture OK: bench_captures/bench_${ts}.json" >&2
+      if [ "$MODE" = "--bench-only" ]; then exit 0; fi
+      timeout 1800 python -m gpu_rscode_tpu.tools.kernel_sweep --mb 64 --trials 2 \
+        > "bench_captures/sweep_${ts}.json" 2> "bench_captures/sweep_${ts}.log"
+      src=$?
+      echo "# sweep rc=$src" >&2
+      exit 0
+    fi
+    echo "# bench rc=$brc but no TPU line; keep looping" >&2
+  fi
+  sleep 120
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
