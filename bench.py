@@ -101,10 +101,20 @@ def _init_backend():
     import os
     import time
 
+    def _no_fallback_guard(name: str) -> None:
+        # The second-chance child must never measure on CPU under ANY of the
+        # probe paths, not just the forced-cpu last resort — a tunnel that
+        # flaps back down between the parent's probe and the child's start
+        # would otherwise make the child burn its whole timeout re-running
+        # the CPU bench (and recursing into its own second chance).
+        if os.environ.get("RS_BENCH_NO_FALLBACK") and name == "cpu":
+            raise SystemExit("probe landed on cpu and RS_BENCH_NO_FALLBACK set")
+
     hung = False
     for attempt in range(3):
         name, hung = _probe_backend()
         if name:
+            _no_fallback_guard(name)
             import jax
 
             # Residual TOCTOU: the tunnel could wedge between the probe and
@@ -124,6 +134,7 @@ def _init_backend():
         # Auto-pick ('' = let jax choose any available platform).
         name, hung = _probe_backend(env_platform="", timeout=60)
         if name:
+            _no_fallback_guard(name)
             import jax
 
             os.environ["JAX_PLATFORMS"] = ""
@@ -331,7 +342,16 @@ def main() -> None:
     except Exception as e:
         detail["decode"] = f"failed: {type(e).__name__}"
     _mark("done")
-    if backend != "tpu" and _second_chance_tpu():
+    # (backend was relabelled "tpu" above whenever the devices are real TPU
+    # chips, however the tunnel registers itself — this guard only fires for
+    # genuine CPU fallbacks.  The child never takes a second chance itself.)
+    import os as _os
+
+    if (
+        backend != "tpu"
+        and not _os.environ.get("RS_BENCH_NO_FALLBACK")
+        and _second_chance_tpu()
+    ):
         return  # the forwarded TPU line is the bench's single output line
     _emit(backend, best[1], {"strategy": best[0], **detail})
 

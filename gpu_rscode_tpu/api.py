@@ -26,7 +26,7 @@ import os
 import numpy as np
 
 from .codec import RSCodec
-from .parallel.pipeline import AsyncWindow
+from .parallel.pipeline import AsyncWindow, SegmentPrefetcher
 from .utils.fileformat import (
     append_checksums,
     chunk_crc32,
@@ -82,6 +82,17 @@ def _segment_cols(chunk_size: int, native_num: int, segment_bytes: int) -> int:
     return min(cols, chunk_size)
 
 
+def _segment_spans(chunk_size: int, seg_cols: int) -> list[tuple[int, int]]:
+    """(off, cols) spans covering [0, chunk_size) in seg_cols steps."""
+    spans = []
+    off = 0
+    while off < chunk_size:
+        cols = min(seg_cols, chunk_size - off)
+        spans.append((off, cols))
+        off += cols
+    return spans
+
+
 def encode_file(
     file_name: str,
     native_num: int,
@@ -126,78 +137,115 @@ def encode_file(
     seg_cols = _segment_cols(chunk, k, segment_bytes)
 
     src = np.memmap(file_name, dtype=np.uint8, mode="r")
-    written: list[str] = []
+
+    # Failure atomicity (same contract decode and repair already keep):
+    # every output — n chunk files AND .METADATA — is written to a
+    # ``.rs_tmp`` name and the whole set is os.replace'd only after every
+    # byte landed.  A mid-encode crash leaves no partial ``_<i>_`` files for
+    # scan_file to misread as a damaged archive.
+    written: list[str] = [
+        chunk_file_name(file_name, i) for i in range(k + p)
+    ] + [metadata_file_name(file_name)]
+    tmps = {name: name + ".rs_tmp" for name in written}
+    preexisting = {name for name in written if os.path.exists(name)}
+    committed: list[str] = []
 
     # Native chunks: straight copies of the k file ranges, tail zero-padded.
     # Copied in bounded slices so a 100 GB chunk never materialises in RAM.
     copy_step = max(1, segment_bytes)
     crcs: dict[int, int] | None = {} if checksums else None
-    with timer.phase("write natives (io)"):
-        for i in range(k):
-            name = chunk_file_name(file_name, i)
-            lo, hi = i * chunk, min((i + 1) * chunk, total_size)
-            crc = 0
-            with open(name, "wb") as fp:
-                for s in range(lo, hi, copy_step):
-                    buf = src[s : min(s + copy_step, hi)].tobytes()
-                    fp.write(buf)
-                    if crcs is not None:
-                        crc = crc32_of(buf, crc)
-                pad = chunk - max(0, hi - lo)
-                zeros = b"\x00" * min(pad, copy_step)
-                for s in range(0, pad, copy_step):
-                    buf = zeros[: min(copy_step, pad - s)]
-                    fp.write(buf)
-                    if crcs is not None:
-                        crc = crc32_of(buf, crc)
-            if crcs is not None:
-                crcs[i] = crc
-            written.append(name)
-
-    # Parity chunks: stream segments through the device.
-    parity_files = []
-    for j in range(p):
-        name = chunk_file_name(file_name, k + j)
-        parity_files.append(open(name, "wb"))
-        written.append(name)
 
     def gather_segment(off: int, cols: int) -> np.ndarray:
         """(k, cols) segment of the striped view, zero-padded.  Uses the
         native pread gather when built (one syscall per row instead of
-        Python slice copies); NumPy fallback reuses the open memmap."""
+        Python slice copies); NumPy fallback reuses the open memmap.
+        Runs on the prefetch worker thread (reads-only: safe)."""
         from . import native
 
-        return native.stripe_read(
-            file_name, chunk, k, off, cols, total_size, fallback_src=src
-        )
+        with timer.phase("stage segment (io)"):
+            return native.stripe_read(
+                file_name, chunk, k, off, cols, total_size, fallback_src=src
+            )
 
+    parity_files: list = []
     try:
-        with AsyncWindow(
-            pipeline_depth,
-            lambda tag, fut: _drain_parity((*tag, fut), parity_files, timer, crcs, k),
-        ) as window:
-            off = 0
-            while off < chunk:
-                cols = min(seg_cols, chunk - off)
-                with timer.phase("stage segment (io)"):
-                    host_seg = gather_segment(off, cols)
-                if sym > 1:  # reinterpret bytes as little-endian symbols
-                    host_seg = host_seg.view(np.uint16)
-                with timer.phase("encode dispatch"):
-                    parity = codec.encode(host_seg)  # async
-                window.push((off, cols), parity)
-                off += cols
-    finally:
-        for fp in parity_files:
-            fp.close()
+        with timer.phase("write natives (io)"):
+            for i in range(k):
+                lo, hi = i * chunk, min((i + 1) * chunk, total_size)
+                crc = 0
+                with open(tmps[chunk_file_name(file_name, i)], "wb") as fp:
+                    for s in range(lo, hi, copy_step):
+                        buf = src[s : min(s + copy_step, hi)].tobytes()
+                        fp.write(buf)
+                        if crcs is not None:
+                            crc = crc32_of(buf, crc)
+                    pad = chunk - max(0, hi - lo)
+                    zeros = b"\x00" * min(pad, copy_step)
+                    for s in range(0, pad, copy_step):
+                        buf = zeros[: min(copy_step, pad - s)]
+                        fp.write(buf)
+                        if crcs is not None:
+                            crc = crc32_of(buf, crc)
+                if crcs is not None:
+                    crcs[i] = crc
 
-    with timer.phase("write metadata (io)"):
-        write_metadata(
-            metadata_file_name(file_name), total_size, p, k, codec.total_matrix, w=w
-        )
-        if crcs is not None:
-            append_checksums(metadata_file_name(file_name), crcs)
-    written.append(metadata_file_name(file_name))
+        # Parity chunks: stream segments through the device, staging on a
+        # worker thread (SegmentPrefetcher) so read IO overlaps the drain's
+        # D2H + parity writes — the three-way overlap of the reference's
+        # stream loop (encode.cu:165-218).
+        for j in range(p):
+            parity_files.append(
+                open(tmps[chunk_file_name(file_name, k + j)], "wb")
+            )
+        try:
+            with SegmentPrefetcher(
+                _segment_spans(chunk, seg_cols), gather_segment,
+                depth=pipeline_depth,
+            ) as prefetch, AsyncWindow(
+                pipeline_depth,
+                lambda tag, fut: _drain_parity(
+                    (*tag, fut), parity_files, timer, crcs, k
+                ),
+            ) as window:
+                for (off, cols), host_seg in prefetch:
+                    if sym > 1:  # reinterpret bytes as little-endian symbols
+                        host_seg = host_seg.view(np.uint16)
+                    with timer.phase("encode dispatch"):
+                        parity = codec.encode(host_seg)  # async
+                    window.push((off, cols), parity)
+        finally:
+            for fp in parity_files:
+                fp.close()
+
+        meta_tmp = tmps[metadata_file_name(file_name)]
+        with timer.phase("write metadata (io)"):
+            write_metadata(meta_tmp, total_size, p, k, codec.total_matrix, w=w)
+            if crcs is not None:
+                append_checksums(meta_tmp, crcs)
+
+        # Commit: chunks first, .METADATA last — its presence is the marker
+        # of a complete encode.
+        for name in written[:-1]:
+            os.replace(tmps[name], name)
+            committed.append(name)
+        os.replace(meta_tmp, metadata_file_name(file_name))
+    except BaseException:
+        for fp in parity_files:
+            if not fp.closed:
+                fp.close()
+        for tmp in tmps.values():
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        # A failure inside the commit loop itself (rename error, interrupt)
+        # may have promoted some chunks already: retract the ones this
+        # encode created so a fresh encode leaves nothing behind.  Names
+        # that pre-existed (re-encode over an archive) are left in place —
+        # their previous bytes are unrecoverable by rename, and a partial
+        # new set still scans/repairs via the old .METADATA.
+        for name in committed:
+            if name not in preexisting and os.path.exists(name):
+                os.unlink(name)
+        raise
     return written
 
 
@@ -371,25 +419,33 @@ def decode_file(
 
             from . import native
 
-            with AsyncWindow(pipeline_depth, drain) as window:
-                off = 0
-                while off < chunk:
-                    cols = min(seg_cols, chunk - off)
-                    if dec_missing is not None:
-                        with timer.phase("stage segment (io)"):
-                            # Native pread gather (one syscall per surviving
-                            # chunk); memmap copies as fallback.
-                            seg = native.gather_rows(
-                                fps, off, cols, fallback_maps=maps
-                            )
+            segments = _segment_spans(chunk, seg_cols)
+
+            if dec_missing is not None:
+
+                def stage(off: int, cols: int) -> np.ndarray:
+                    # Native pread gather (one syscall per surviving chunk);
+                    # memmap copies as fallback.  Runs on the prefetch
+                    # worker so read IO overlaps the drain's output writes.
+                    with timer.phase("stage segment (io)"):
+                        return native.gather_rows(
+                            fps, off, cols, fallback_maps=maps
+                        )
+
+                with SegmentPrefetcher(
+                    segments, stage, depth=pipeline_depth
+                ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+                    for (off, cols), seg in prefetch:
                         if sym > 1:
                             seg = seg.view(np.uint16)
                         with timer.phase("decode dispatch"):
                             rec = codec.decode(dec_missing, seg)  # async
-                    else:
-                        rec = None  # all natives survived: pure copy
-                    window.push((off, cols), rec)
-                    off += cols
+                        window.push((off, cols), rec)
+            else:
+                with AsyncWindow(pipeline_depth, drain) as window:
+                    for off, cols in segments:
+                        # all natives survived: pure copy, nothing staged
+                        window.push((off, cols), None)
             out_fp.truncate(total_size)
     finally:
         for fp in fps:
@@ -644,21 +700,23 @@ def repair_file(
             for j, t in enumerate(targets):
                 new_crcs[t] = crc32_of(reb[j], new_crcs.get(t, 0))
 
+    def stage(off: int, cols: int) -> np.ndarray:
+        # On the prefetch worker: survivor reads overlap rebuilt-chunk writes.
+        with timer.phase("stage segment (io)"):
+            return native.gather_rows(
+                surv_fps, off, cols, fallback_maps=surv_maps
+            )
+
     try:
-        with AsyncWindow(pipeline_depth, drain) as window:
-            off = 0
-            while off < chunk:
-                cols = min(seg_cols, chunk - off)
-                with timer.phase("stage segment (io)"):
-                    seg = native.gather_rows(
-                        surv_fps, off, cols, fallback_maps=surv_maps
-                    )
+        with SegmentPrefetcher(
+            _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
+        ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+            for (off, cols), seg in prefetch:
                 if sym > 1:
                     seg = seg.view(np.uint16)
                 with timer.phase("repair dispatch"):
                     rebuilt = codec.decode(rebuild_mat, seg)  # async GEMM
                 window.push((off, cols), rebuilt)
-                off += cols
         for t in targets:
             out_fps[t].close()
         for t in targets:

@@ -14,7 +14,9 @@ of segment i+1 overlaps compute of segment i.
 
 from __future__ import annotations
 
-from collections.abc import Callable
+import queue
+import threading
+from collections.abc import Callable, Iterable
 from typing import Any, Generic, TypeVar
 
 T = TypeVar("T")
@@ -54,4 +56,98 @@ class AsyncWindow(Generic[T]):
             self.flush()
         else:
             self._pending.clear()
+        return False
+
+
+class SegmentPrefetcher:
+    """Stage segments on a worker thread into a bounded queue.
+
+    Completes the three-way overlap of the reference's stream loop
+    (encode.cu:165-218: H2D || kernel || D2H): JAX's async dispatch already
+    overlaps device compute with the drain's D2H+write, but in a
+    single-threaded loop the *read* of segment i+depth only starts after the
+    drain of segment i returns — read IO and write IO serialize.  With the
+    pread gather on its own thread, steady-state encode wall approaches
+    max(read, compute, write) instead of read + max(compute, write).
+
+    ``segments``: (off, cols) tags, staged in order.  ``produce(off, cols)``
+    runs on the worker thread (it must be thread-safe against the consumer's
+    work — the pread/memmap gathers are: distinct fds/offsets).  ``depth``
+    bounds staged-but-unconsumed segments, so host memory holds at most
+    ``depth + 1`` staged segments beyond the AsyncWindow's in-flight ones.
+
+    Iterating yields ``((off, cols), staged)`` in order.  A ``produce``
+    exception re-raises at the consuming ``__next__``.  Exiting the context
+    early (consumer exception) cancels the worker promptly: the worker
+    checks a stop flag before each stage and uses timeouts around queue
+    puts.
+    """
+
+    _STOP = object()
+
+    def __init__(
+        self,
+        segments: Iterable[tuple[int, int]],
+        produce: Callable[[int, int], Any],
+        depth: int = 2,
+    ):
+        self._segments = list(segments)
+        self._produce = produce
+        self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="rs-segment-prefetch", daemon=True
+        )
+
+    def _run(self) -> None:
+        try:
+            for off, cols in self._segments:
+                if self._stop.is_set():
+                    return
+                item = ((off, cols), self._produce(off, cols))
+                while not self._stop.is_set():
+                    try:
+                        self._q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                else:
+                    return
+            self._put_forever((self._STOP, None))
+        except BaseException as e:  # noqa: BLE001 — relayed to the consumer
+            self._put_forever((self._STOP, e))
+
+    def _put_forever(self, item) -> None:
+        while not self._stop.is_set():
+            try:
+                self._q.put(item, timeout=0.1)
+                return
+            except queue.Full:
+                continue
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        tag, item = self._q.get()
+        if tag is self._STOP:
+            self._stop.set()  # idempotent; lets join() return fast
+            if item is not None:
+                raise item
+            raise StopIteration
+        return tag, item
+
+    def __enter__(self):
+        self._thread.start()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._stop.set()
+        # Unblock a worker waiting on put() by draining whatever is queued.
+        try:
+            while True:
+                self._q.get_nowait()
+        except queue.Empty:
+            pass
+        self._thread.join(timeout=30)
         return False
