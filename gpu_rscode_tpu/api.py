@@ -93,6 +93,48 @@ def _segment_spans(chunk_size: int, seg_cols: int) -> list[tuple[int, int]]:
     return spans
 
 
+def _mesh_processes(mesh) -> list[int]:
+    """Sorted process indices a mesh's devices span ([] for mesh=None)."""
+    if mesh is None:
+        return []
+    return sorted({d.process_index for d in mesh.devices.flat})
+
+
+def _write_native_chunks(
+    src: np.ndarray,
+    file_name: str,
+    tmps: dict[str, str],
+    k: int,
+    chunk: int,
+    total_size: int,
+    copy_step: int,
+    crcs: dict[int, int] | None,
+    timer: PhaseTimer,
+) -> None:
+    """Write the k native chunk temp files: straight copies of the k file
+    ranges, tail zero-padded, in bounded slices (a 100 GB chunk never
+    materialises in RAM), with optional incremental CRC32."""
+    with timer.phase("write natives (io)"):
+        for i in range(k):
+            lo, hi = i * chunk, min((i + 1) * chunk, total_size)
+            crc = 0
+            with open(tmps[chunk_file_name(file_name, i)], "wb") as fp:
+                for s in range(lo, hi, copy_step):
+                    buf = src[s : min(s + copy_step, hi)].tobytes()
+                    fp.write(buf)
+                    if crcs is not None:
+                        crc = crc32_of(buf, crc)
+                pad = chunk - max(0, hi - lo)
+                zeros = b"\x00" * min(pad, copy_step)
+                for s in range(0, pad, copy_step):
+                    buf = zeros[: min(copy_step, pad - s)]
+                    fp.write(buf)
+                    if crcs is not None:
+                        crc = crc32_of(buf, crc)
+            if crcs is not None:
+                crcs[i] = crc
+
+
 def encode_file(
     file_name: str,
     native_num: int,
@@ -136,6 +178,12 @@ def encode_file(
     chunk = chunk_size_for(total_size, k, sym)
     seg_cols = _segment_cols(chunk, k, segment_bytes)
 
+    if len(_mesh_processes(mesh)) > 1:
+        return _encode_file_multiprocess(
+            file_name, codec, chunk, total_size, seg_cols,
+            checksums=checksums, pipeline_depth=pipeline_depth, timer=timer,
+        )
+
     src = np.memmap(file_name, dtype=np.uint8, mode="r")
 
     # Failure atomicity (same contract decode and repair already keep):
@@ -169,25 +217,9 @@ def encode_file(
 
     parity_files: list = []
     try:
-        with timer.phase("write natives (io)"):
-            for i in range(k):
-                lo, hi = i * chunk, min((i + 1) * chunk, total_size)
-                crc = 0
-                with open(tmps[chunk_file_name(file_name, i)], "wb") as fp:
-                    for s in range(lo, hi, copy_step):
-                        buf = src[s : min(s + copy_step, hi)].tobytes()
-                        fp.write(buf)
-                        if crcs is not None:
-                            crc = crc32_of(buf, crc)
-                    pad = chunk - max(0, hi - lo)
-                    zeros = b"\x00" * min(pad, copy_step)
-                    for s in range(0, pad, copy_step):
-                        buf = zeros[: min(copy_step, pad - s)]
-                        fp.write(buf)
-                        if crcs is not None:
-                            crc = crc32_of(buf, crc)
-                if crcs is not None:
-                    crcs[i] = crc
+        _write_native_chunks(
+            src, file_name, tmps, k, chunk, total_size, copy_step, crcs, timer
+        )
 
         # Parity chunks: stream segments through the device, staging on a
         # worker thread (SegmentPrefetcher) so read IO overlaps the drain's
@@ -266,6 +298,185 @@ def _drain_parity(entry, parity_files, timer, crcs=None, k=0) -> None:
         native.scatter_write(parity_files, parity_np, off)
 
 
+def _encode_file_multiprocess(
+    file_name: str,
+    codec: RSCodec,
+    chunk: int,
+    total_size: int,
+    seg_cols: int,
+    *,
+    checksums: bool,
+    pipeline_depth: int,
+    timer: PhaseTimer,
+) -> list[str]:
+    """Multi-host file encode over a process-spanning mesh.
+
+    The reference tops out at one machine (pthread-per-GPU, SURVEY §2);
+    this is the genuinely-distributed extension: every participating host
+    stages only ITS column range of each segment (the byte ranges its mesh
+    devices own), the global array is assembled with
+    ``make_array_from_process_local_data`` (put_sharded's multi-process
+    branch), the sharded GEMM runs collectively, and each host writes only
+    its addressable output shards into the shared-filesystem chunk files.
+    Requirements: a shared filesystem, cols-only sharding, w=8.
+
+    All processes must call encode_file with the same arguments (it is a
+    collective).  The lead process (lowest process index in the mesh)
+    writes natives and .METADATA and performs the atomic promotion; the
+    cross-process barriers are ``sync_global_devices``.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from . import native
+    from .parallel.mesh import COLS
+    from .parallel.sharded import put_sharded, sharded_gf_matmul
+
+    mesh = codec.mesh
+    k, p = codec.native_num, codec.parity_num
+    if codec.stripe_sharded:
+        raise NotImplementedError(
+            "multi-process file encode shards the cols axis only "
+            "(stripe_sharded=True is a single-process mesh feature)"
+        )
+    if codec.w != 8:
+        raise NotImplementedError("multi-process file encode supports w=8 only")
+
+    lead = jax.process_index() == min(
+        d.process_index for d in mesh.devices.flat
+    )
+    cols_size = mesh.shape[COLS]
+    sharding = NamedSharding(mesh, P(None, COLS))
+
+    written: list[str] = [
+        chunk_file_name(file_name, i) for i in range(k + p)
+    ] + [metadata_file_name(file_name)]
+    tmps = {name: name + ".rs_tmp" for name in written}
+    parity_names = [chunk_file_name(file_name, k + j) for j in range(p)]
+
+    src = np.memmap(file_name, dtype=np.uint8, mode="r")
+    copy_step = max(1, seg_cols * k)
+    crcs: dict[int, int] | None = {} if checksums else None
+    preexisting = {name for name in written if os.path.exists(name)}
+    committed: list[str] = []
+
+    try:
+        if lead:
+            _write_native_chunks(
+                src, file_name, tmps, k, chunk, total_size, copy_step, crcs,
+                timer,
+            )
+            # Pre-size parity temp files so every process can open r+b and
+            # pwrite its shard ranges.
+            for name in parity_names:
+                with open(tmps[name], "wb") as fp:
+                    fp.truncate(chunk)
+        multihost_utils.sync_global_devices("rs_encode_files_created")
+
+        def local_span(W: int) -> tuple[int, int]:
+            """This process's contiguous column range of a (k, W) segment."""
+            idx = sharding.addressable_devices_indices_map((k, W))
+            spans = sorted((s[1].start, s[1].stop) for s in idx.values())
+            lo, hi = spans[0][0], spans[-1][1]
+            if any(a[1] != b[0] for a, b in zip(spans, spans[1:])):
+                raise ValueError(
+                    "mesh cols axis gives this process a non-contiguous "
+                    "column range; build the mesh from jax.devices() order"
+                )
+            return lo, hi
+
+        def stage(off: int, cols: int):
+            # Padded global width (equal per-device shards for
+            # make_array_from_process_local_data); parity of the zero pad is
+            # zero and is trimmed at write time.
+            W = ((cols + cols_size - 1) // cols_size) * cols_size
+            lo, hi = local_span(W)
+            with timer.phase("stage segment (io)"):
+                return native.stripe_read(
+                    file_name, chunk, k, off + lo, hi - lo, total_size,
+                    fallback_src=src,
+                )
+
+        parity_fps = [open(tmps[name], "r+b") for name in parity_names]
+        try:
+
+            def drain(tag, parity_sharded) -> None:
+                off, cols = tag
+                with timer.phase("encode compute"):
+                    shards = [
+                        (sh.index[1].start, np.asarray(sh.data))
+                        for sh in parity_sharded.addressable_shards
+                    ]
+                with timer.phase("write parity (io)"):
+                    for col0, data in shards:
+                        n_cols = min(data.shape[1], cols - col0)
+                        if n_cols <= 0:
+                            continue
+                        for j in range(p):
+                            os.pwrite(
+                                parity_fps[j].fileno(),
+                                np.ascontiguousarray(
+                                    data[j, :n_cols]
+                                ).tobytes(),
+                                off + col0,
+                            )
+
+            with SegmentPrefetcher(
+                _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
+            ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+                for (off, cols), local_seg in prefetch:
+                    with timer.phase("encode dispatch"):
+                        Bd = put_sharded(local_seg, mesh, False)
+                        parity = sharded_gf_matmul(
+                            np.asarray(codec.parity_block), Bd,
+                            mesh=mesh, w=codec.w, strategy=codec.strategy,
+                            stripe_sharded=False,
+                        )
+                    window.push((off, cols), parity)
+        finally:
+            for fp in parity_fps:
+                fp.close()
+        multihost_utils.sync_global_devices("rs_encode_parity_written")
+
+        if lead:
+            if crcs is not None:
+                # Parity rows were written by many hosts; the lead reads the
+                # finished temp files back for the checksum lines.
+                with timer.phase("write metadata (io)"):
+                    for j, name in enumerate(parity_names):
+                        mm = np.memmap(tmps[name], dtype=np.uint8, mode="r")
+                        crcs[k + j] = chunk_crc32(mm, chunk, copy_step)
+            meta_tmp = tmps[metadata_file_name(file_name)]
+            with timer.phase("write metadata (io)"):
+                write_metadata(
+                    meta_tmp, total_size, p, k, codec.total_matrix, w=codec.w
+                )
+                if crcs is not None:
+                    append_checksums(meta_tmp, crcs)
+            for name in written[:-1]:
+                os.replace(tmps[name], name)
+                committed.append(name)
+            os.replace(meta_tmp, metadata_file_name(file_name))
+    except BaseException:
+        # Same atomicity contract as the single-process path, applied to
+        # the SHARED filesystem: unlink every temp (any process can — the
+        # paths are common), and retract chunks this encode promoted that
+        # did not pre-exist.  A process that fails before a barrier leaves
+        # its peers blocked in sync_global_devices until the jax
+        # coordinator tears the job down — the shared-FS state is clean
+        # either way.
+        for tmp in tmps.values():
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        for name in committed:
+            if name not in preexisting and os.path.exists(name):
+                os.unlink(name)
+        raise
+    multihost_utils.sync_global_devices("rs_encode_promoted")
+    return written
+
+
 def decode_file(
     in_file: str,
     conf_file: str,
@@ -289,6 +500,13 @@ def decode_file(
     the corrupt chunks so the caller can retry with different survivors.
     """
     timer = timer or PhaseTimer(enabled=False)
+    if len(_mesh_processes(mesh)) > 1:
+        # Checked before any archive IO — the checksum pre-pass below reads
+        # every chunk, which would be wasted work ahead of this error.
+        raise NotImplementedError(
+            "multi-process file decode is not implemented (encode is); "
+            "decode with a single-process mesh"
+        )
     with timer.phase("read metadata (io)"):
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(
             metadata_file_name(in_file)
@@ -655,6 +873,11 @@ def repair_file(
     from .ops.gf import get_field
 
     timer = timer or PhaseTimer(enabled=False)
+    if len(_mesh_processes(mesh)) > 1:
+        raise NotImplementedError(
+            "multi-process repair is not implemented; repair with a "
+            "single-process mesh"
+        )
     with timer.phase("scan chunks (io)"):
         scan = _scan_chunks(in_file, segment_bytes)
     targets = scan.unhealthy

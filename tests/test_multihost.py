@@ -25,7 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_sharded_gemm():
+def test_two_process_sharded_gemm(tmp_path):
     port = _free_port()
     procs = []
     for pid in range(2):
@@ -40,6 +40,8 @@ def test_two_process_sharded_gemm():
             "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
             "JAX_NUM_PROCESSES": "2",
             "JAX_PROCESS_ID": str(pid),
+            # Shared "filesystem" for the multi-host file-layer encode.
+            "RS_MULTIHOST_DIR": str(tmp_path),
         }
         procs.append(
             subprocess.Popen(
