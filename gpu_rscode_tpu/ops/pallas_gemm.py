@@ -25,9 +25,12 @@ reference's grid-stride column sweep, matrix.cu:265-322).  Out-of-range
 columns in the last tile compute garbage on garbage and are dropped by the
 masked output write Pallas performs automatically.
 
-Two bit-expansion formulations (``expand``), both bit-verified; the 2026-07
-v5e sweep (tools/kernel_sweep.py) showed the kernel is compute-bound on the
-expansion (DMA floor ~268 GB/s vs ~63 GB/s end-to-end), motivating "sign":
+Three bit-expansion formulations (``expand``), all bit-verified in interpret
+mode; the committed 2026-07-30 v5e captures (bench_captures/) show the kernel
+is compute-bound on the expansion — compute-only ceiling ~63.5 GB/s vs a DMA
+floor measured between 87 and 181 GB/s across runs (tunnel jitter), kernel
+end-to-end 64.3-64.6 GB/s at tile 16384/32768
+(bench_captures/tile_pick_tpu_*.jsonl, kernel_sweep_tpu_*.jsonl):
 
 * ``"shift"`` — plane s = (b >> s) & 1 in int32 lanes (proven default).
 * ``"sign"``  — plane s = (int_w)(b << (w-1-s)) >> (w-1), i.e. {0, -1},
@@ -39,6 +42,17 @@ expansion (DMA floor ~268 GB/s vs ~63 GB/s end-to-end), motivating "sign":
   compare-based VPU expansion, 4x the MXU contraction depth.  The MXU
   analog of the reference's fastest kernel — the GF(16) nibble-table
   branch (design.tex:485 9.12 ms vs 160.5 ms; gf16.h:1-22).
+
+Hardware verdict (2026-07-30, real v5e, committed captures): ``"shift"`` is
+the production default — 64.3-64.6 GB/s, ~98 % of the measured compute-only
+ceiling.  ``"sign"`` and ``"nibble"`` do NOT lower on the current Mosaic
+toolchain (sign: ``arith.subi`` on int8 vectors fails to legalize; nibble:
+8-bit iota unsupported; reworked int32-iota formulations crash the compile
+helper) — see bench_captures/tile_pick_tpu_*.jsonl and
+bench_captures/expand_probe_tpu_*.jsonl.  Both remain available for
+interpret mode (bit-verified in CI) and for future toolchains; a packed
+uint8 mask-compare variant was also probed on hardware and measured slower
+than shift (40.7 vs 64.4 GB/s, same capture).
 """
 
 from __future__ import annotations
@@ -54,7 +68,10 @@ from jax.experimental.pallas import tpu as pltpu
 from .gf import get_field
 
 DEFAULT_TILE = 2048      # interpret / CPU-mesh default
-TPU_TILE = 16384         # measured best on v5e (.sweep: 61.7 GB/s vs 42 @ 2048)
+# Measured best on real v5e, production path, 320 MB per timed call
+# (bench_captures/tile_pick_tpu_20260730T050344Z.jsonl: 64.33 @ 16384, 64.63 @
+# 32768 — a tie within tunnel jitter; 47.11 @ 8192, 56.91 @ 65536).
+TPU_TILE = 16384
 
 
 def _expand_shift(b, w, k, tile):
@@ -183,11 +200,14 @@ def gf_matmul_pallas(
     ``acc_dtype``: matmul input dtype — ``int8`` (int32 accumulation, exact
     for contraction depth < 2^31; 2x MXU rate on v5e) or ``bfloat16`` (f32
     accumulation, exact for depth < 2^24).  Both bit-verified; defaults are
-    the measured-best per backend (v5e sweep 2026-07: int8 @ tile 16384 =
-    61.7 GB/s, bf16 @ 2048 = 42.1 GB/s).
+    the measured-best per backend (committed v5e capture
+    bench_captures/tile_pick_tpu_20260730T050344Z.jsonl: int8 @ tile 16384 =
+    64.3 GB/s).
     ``expand``: data-expansion formulation — "shift" (default), "sign", or
     "nibble" (w=8 only: one-hot nibble planes against the (p*w, k*32)
-    operator; see module docstring).
+    operator; see module docstring).  On the current TPU toolchain only
+    "shift" lowers to hardware — "sign"/"nibble" fail Mosaic legalization
+    (see the module docstring's hardware verdict) and serve interpret mode.
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """

@@ -180,7 +180,15 @@ def main():
     ap.add_argument(
         "--tiles", type=str, default="8192,16384,32768,65536"
     )
+    ap.add_argument(
+        "--bodies", type=str, default="base,cmp,sign,signc,signf,nibble",
+        help="comma-separated subset of kernel bodies to sweep",
+    )
     args = ap.parse_args()
+    bodies = [b.strip() for b in args.bodies.split(",") if b.strip()]
+    unknown = [b for b in bodies if b not in BODIES]
+    if unknown:
+        ap.error(f"unknown --bodies {unknown}; choose from {sorted(BODIES)}")
 
     # The tunnel backend may self-report as "axon" while its devices are real
     # TPU chips — gate on the device platform, not the registration name.
@@ -204,7 +212,7 @@ def main():
 
     tiles = [int(t) for t in args.tiles.split(",")]
     results = {}
-    for name in ("base", "cmp", "sign", "signc", "signf", "nibble"):
+    for name in bodies:
         for tile in tiles:
             fn = make_fn(name, A_nib if name in NIBBLE_BODIES else A_bits, Bd, tile)
             try:
@@ -218,13 +226,17 @@ def main():
                 results[f"{name}@{tile}"] = f"fail:{type(e).__name__}"
             print(json.dumps({f"{name}@{tile}": results[f"{name}@{tile}"]}))
 
-    # floors at the best tile so far
-    best_tile = max(
-        (t for t in tiles),
-        key=lambda t: results.get(f"base@{t}", 0)
-        if isinstance(results.get(f"base@{t}"), float)
-        else 0,
-    )
+    # floors at the best measured tile across whatever bodies ran (not just
+    # "base" — a --bodies subset without it must not silently pick tiles[0])
+    def _tile_best(t):
+        vals = [
+            results.get(f"{b}@{t}")
+            for b in bodies
+            if isinstance(results.get(f"{b}@{t}"), float)
+        ]
+        return max(vals, default=0.0)
+
+    best_tile = max(tiles, key=_tile_best)
     for name, pinned in (("dma", False), ("base", True)):
         key = "dma_floor" if name == "dma" else "compute_only"
         try:
