@@ -374,24 +374,12 @@ def _encode_file_multiprocess(
                     fp.truncate(chunk)
         multihost_utils.sync_global_devices("rs_encode_files_created")
 
-        def local_span(W: int) -> tuple[int, int]:
-            """This process's contiguous column range of a (k, W) segment."""
-            idx = sharding.addressable_devices_indices_map((k, W))
-            spans = sorted((s[1].start, s[1].stop) for s in idx.values())
-            lo, hi = spans[0][0], spans[-1][1]
-            if any(a[1] != b[0] for a, b in zip(spans, spans[1:])):
-                raise ValueError(
-                    "mesh cols axis gives this process a non-contiguous "
-                    "column range; build the mesh from jax.devices() order"
-                )
-            return lo, hi
-
         def stage(off: int, cols: int):
             # Padded global width (equal per-device shards for
             # make_array_from_process_local_data); parity of the zero pad is
             # zero and is trimmed at write time.
             W = ((cols + cols_size - 1) // cols_size) * cols_size
-            lo, hi = local_span(W)
+            lo, hi = _local_col_span(sharding, k, W)
             with timer.phase("stage segment (io)"):
                 return native.stripe_read(
                     file_name, chunk, k, off + lo, hi - lo, total_size,
@@ -461,17 +449,16 @@ def _encode_file_multiprocess(
     except BaseException:
         # Same atomicity contract as the single-process path, applied to
         # the SHARED filesystem: unlink every temp (any process can — the
-        # paths are common), and retract chunks this encode promoted that
-        # did not pre-exist.  A process that fails before a barrier leaves
-        # its peers blocked in sync_global_devices until the jax
+        # paths are common, and losing the unlink race to a peer cleaning
+        # the same path is fine), and retract chunks this encode promoted
+        # that did not pre-exist.  A process that fails before a barrier
+        # leaves its peers blocked in sync_global_devices until the jax
         # coordinator tears the job down — the shared-FS state is clean
         # either way.
-        for tmp in tmps.values():
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-        for name in committed:
-            if name not in preexisting and os.path.exists(name):
-                os.unlink(name)
+        _unlink_shared_tmps(tmps.values())
+        _unlink_shared_tmps(
+            name for name in committed if name not in preexisting
+        )
         raise
     multihost_utils.sync_global_devices("rs_encode_promoted")
     return written
@@ -501,11 +488,19 @@ def decode_file(
     """
     timer = timer or PhaseTimer(enabled=False)
     if len(_mesh_processes(mesh)) > 1:
-        # Checked before any archive IO — the checksum pre-pass below reads
-        # every chunk, which would be wasted work ahead of this error.
-        raise NotImplementedError(
-            "multi-process file decode is not implemented (encode is); "
-            "decode with a single-process mesh"
+        # Checked before any archive IO (the checksum pre-pass below reads
+        # every chunk): the multi-process path does its own lead-verified
+        # pre-pass and collective recovery.
+        if stripe_sharded:
+            raise NotImplementedError(
+                "multi-process file decode shards the cols axis only "
+                "(stripe_sharded=True is a single-process mesh feature)"
+            )
+        return _decode_file_multiprocess(
+            in_file, conf_file, output,
+            strategy=strategy, segment_bytes=segment_bytes,
+            pipeline_depth=pipeline_depth, mesh=mesh,
+            verify_checksums=verify_checksums, timer=timer,
         )
     with timer.phase("read metadata (io)"):
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(
@@ -669,6 +664,265 @@ def decode_file(
         for fp in fps:
             fp.close()
     os.replace(tmp_path, out_path)
+    return out_path
+
+
+def _local_col_span(sharding, k: int, W: int) -> tuple[int, int]:
+    """This process's contiguous column range of a (k, W) cols-sharded
+    global array (shared by the multi-process encode/decode/repair
+    collectives)."""
+    idx = sharding.addressable_devices_indices_map((k, W))
+    spans = sorted((s[1].start, s[1].stop) for s in idx.values())
+    lo, hi = spans[0][0], spans[-1][1]
+    if any(a[1] != b[0] for a, b in zip(spans, spans[1:])):
+        raise ValueError(
+            "mesh cols axis gives this process a non-contiguous "
+            "column range; build the mesh from jax.devices() order"
+        )
+    return lo, hi
+
+
+def _make_padded_stage(fps, maps, chunk, cols_size, sharding, k, timer):
+    """Segment stager shared by the multi-process decode and repair
+    collectives: reads this process's column span of the k survivor files,
+    zero-filling the pad columns past the chunk end (equal per-device
+    shards need the padded width; the pad's decoded garbage is dropped by
+    the trimmed writes)."""
+    from . import native
+
+    def stage(off: int, cols: int):
+        W = ((cols + cols_size - 1) // cols_size) * cols_size
+        lo, hi = _local_col_span(sharding, k, W)
+        readable = max(0, min(off + hi, chunk) - (off + lo))
+        with timer.phase("stage segment (io)"):
+            seg = np.zeros((k, hi - lo), dtype=np.uint8)
+            if readable:
+                seg[:, :readable] = native.gather_rows(
+                    fps, off + lo, readable, fallback_maps=maps
+                )
+            return seg
+
+    return stage
+
+
+def _unlink_shared_tmps(paths) -> None:
+    """Best-effort cleanup of shared-FS temp files from a failing
+    collective: every process runs this near-simultaneously against the
+    same paths, so losing the exists/unlink race to a peer is success, not
+    an error to bury the original exception under."""
+    for tmp in paths:
+        try:
+            os.unlink(tmp)
+        except FileNotFoundError:
+            pass
+
+
+def _decode_file_multiprocess(
+    in_file: str,
+    conf_file: str,
+    output: str | None,
+    *,
+    strategy: str,
+    segment_bytes: int,
+    pipeline_depth: int,
+    mesh,
+    verify_checksums: bool | None,
+    timer: PhaseTimer,
+) -> str:
+    """Multi-host file decode over a process-spanning mesh (collective).
+
+    Mirrors :func:`_encode_file_multiprocess`: every host stages only its
+    column span of each survivor segment, the recovery GEMM runs sharded
+    over the mesh, and each host pwrites its addressable output shards into
+    a shared-filesystem temp the lead process pre-sizes and atomically
+    promotes.  Surviving-native passthrough rows are copied round-robin
+    across hosts (partial recovery — only the missing rows ride the
+    device).  The checksum pre-pass runs on the lead only and its verdict
+    is broadcast, so a corrupt survivor raises the same
+    :class:`ChunkIntegrityError` on every process instead of wedging peers
+    at a barrier.  Requirements: shared filesystem, cols-only sharding,
+    w=8 (same contract as multi-process encode).
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .parallel.mesh import COLS
+    from .parallel.sharded import put_sharded, sharded_gf_matmul
+
+    procs = _mesh_processes(mesh)
+    lead = jax.process_index() == procs[0]
+
+    with timer.phase("read metadata (io)"):
+        total_size, p, k, total_mat, w, crcs = read_metadata_ext(
+            metadata_file_name(in_file)
+        )
+    if w != 8:
+        raise NotImplementedError("multi-process file decode supports w=8 only")
+    if total_mat is None:
+        total_mat = _regenerate_total_matrix(p, k, w)
+    if int(total_mat.max(initial=0)) >= (1 << w):
+        raise ValueError(
+            f"metadata matrix entry {int(total_mat.max())} out of range for "
+            f"GF(2^{w}) — corrupt or foreign .METADATA"
+        )
+    chunk = chunk_size_for(total_size, k, 1)
+    names = read_conf(conf_file)
+    if len(names) != k:
+        raise ValueError(f"conf file lists {len(names)} chunks, need k={k}")
+    rows = [parse_chunk_index(nm) for nm in names]
+
+    conf_dir = os.path.dirname(os.path.abspath(conf_file))
+
+    def resolve(nm: str) -> str:
+        for cand in (nm, os.path.join(conf_dir, os.path.basename(nm)),
+                     os.path.join(os.path.dirname(os.path.abspath(in_file)),
+                                  os.path.basename(nm))):
+            if os.path.exists(cand):
+                return cand
+        raise FileNotFoundError(f"surviving chunk {nm!r} not found")
+
+    with timer.phase("open chunks (io)"):
+        maps, paths = [], []
+        for nm in names:
+            path = resolve(nm)
+            mm = np.memmap(path, dtype=np.uint8, mode="r")
+            if mm.shape[0] < chunk:
+                raise ValueError(
+                    f"chunk {path!r} is {mm.shape[0]} bytes, expected {chunk}"
+                )
+            maps.append(mm)
+            paths.append(path)
+
+    if verify_checksums is not False:
+        if verify_checksums and not crcs:
+            raise ValueError(
+                f"{metadata_file_name(in_file)!r} has no checksum lines "
+                "but verify_checksums=True"
+            )
+        if crcs:
+            uncovered = [r for r in rows if r not in crcs]
+            if verify_checksums and uncovered:
+                raise ValueError(
+                    f"metadata has no CRC for survivor chunk(s) {uncovered} "
+                    "but verify_checksums=True"
+                )
+            # Lead-only CRC pass; verdict broadcast as a (k,) 0/1 mask so
+            # every process raises (or proceeds) in lockstep.
+            with timer.phase("verify checksums"):
+                bad_mask = np.zeros(k, dtype=np.int32)
+                if lead:
+                    for pos, (row, mm) in enumerate(zip(rows, maps)):
+                        if row in crcs and (
+                            chunk_crc32(mm, chunk, segment_bytes) != crcs[row]
+                        ):
+                            bad_mask[pos] = 1
+                bad_mask = np.asarray(
+                    multihost_utils.broadcast_one_to_all(
+                        bad_mask, is_source=lead
+                    )
+                )
+                if bad_mask.any():
+                    raise ChunkIntegrityError({
+                        rows[pos]: paths[pos]
+                        for pos in np.flatnonzero(bad_mask)
+                    })
+
+    codec = RSCodec(k, p, w=w, strategy=strategy, mesh=mesh)
+    total_mat = total_mat.astype(codec.gf.dtype)
+    with timer.phase("invert matrix"):
+        dec_mat = codec.decode_matrix_from(total_mat, rows)
+
+    # Same partial-recovery split as the single-process path.
+    systematic = np.array_equal(total_mat[:k], np.eye(k, dtype=total_mat.dtype))
+    native_pos = (
+        {r: idx for idx, r in enumerate(rows) if r < k} if systematic else {}
+    )
+    missing = [i for i in range(k) if i not in native_pos]
+    dec_missing = dec_mat[missing] if missing else None
+
+    out_path = output or in_file
+    tmp_path = out_path + ".rs_tmp"
+    seg_cols = _segment_cols(chunk, k, segment_bytes)
+    cols_size = mesh.shape[COLS]
+    sharding = NamedSharding(mesh, P(None, COLS))
+    copy_step = max(1, segment_bytes)
+
+    try:
+        if lead:
+            with open(tmp_path, "wb") as fp:
+                fp.truncate(total_size)
+        multihost_utils.sync_global_devices("rs_decode_tmp_created")
+
+        out_fp = open(tmp_path, "r+b")
+        fps = [open(p_, "rb") for p_ in paths] if dec_missing is not None else []
+        try:
+            def pwrite_row(i: int, off: int, row_bytes: np.ndarray) -> None:
+                lo = i * chunk + off
+                if lo >= total_size:
+                    return
+                hi = min(lo + row_bytes.shape[0], total_size)
+                os.pwrite(
+                    out_fp.fileno(),
+                    np.ascontiguousarray(row_bytes[: hi - lo]).tobytes(),
+                    lo,
+                )
+
+            # Surviving natives: straight host copies, split round-robin
+            # across the participating hosts (no device involved).
+            with timer.phase("write output (io)"):
+                my_rank = procs.index(jax.process_index())
+                for idx, i in enumerate(sorted(native_pos)):
+                    if idx % len(procs) != my_rank:
+                        continue
+                    mm = maps[native_pos[i]]
+                    for s in range(0, chunk, copy_step):
+                        pwrite_row(i, s, mm[s : min(s + copy_step, chunk)])
+
+            if dec_missing is not None:
+                stage = _make_padded_stage(
+                    fps, maps, chunk, cols_size, sharding, k, timer
+                )
+
+                def drain(tag, rec_sharded) -> None:
+                    off, cols = tag
+                    with timer.phase("decode compute"):
+                        shards = [
+                            (sh.index[1].start, np.asarray(sh.data))
+                            for sh in rec_sharded.addressable_shards
+                        ]
+                    with timer.phase("write output (io)"):
+                        for col0, data in shards:
+                            n_cols = min(data.shape[1], cols - col0)
+                            if n_cols <= 0:
+                                continue
+                            for j, i in enumerate(missing):
+                                pwrite_row(i, off + col0, data[j, :n_cols])
+
+                with SegmentPrefetcher(
+                    _segment_spans(chunk, seg_cols), stage,
+                    depth=pipeline_depth,
+                ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+                    for (off, cols), local_seg in prefetch:
+                        with timer.phase("decode dispatch"):
+                            Bd = put_sharded(local_seg, mesh, False)
+                            rec = sharded_gf_matmul(
+                                np.asarray(dec_missing), Bd,
+                                mesh=mesh, w=w, strategy=codec.strategy,
+                                stripe_sharded=False,
+                            )
+                        window.push((off, cols), rec)
+        finally:
+            out_fp.close()
+            for fp in fps:
+                fp.close()
+        multihost_utils.sync_global_devices("rs_decode_written")
+        if lead:
+            os.replace(tmp_path, out_path)
+    except BaseException:
+        _unlink_shared_tmps([tmp_path])
+        raise
+    multihost_utils.sync_global_devices("rs_decode_promoted")
     return out_path
 
 
@@ -874,9 +1128,14 @@ def repair_file(
 
     timer = timer or PhaseTimer(enabled=False)
     if len(_mesh_processes(mesh)) > 1:
-        raise NotImplementedError(
-            "multi-process repair is not implemented; repair with a "
-            "single-process mesh"
+        if stripe_sharded:
+            raise NotImplementedError(
+                "multi-process repair shards the cols axis only "
+                "(stripe_sharded=True is a single-process mesh feature)"
+            )
+        return _repair_file_multiprocess(
+            in_file, strategy=strategy, segment_bytes=segment_bytes,
+            pipeline_depth=pipeline_depth, mesh=mesh, timer=timer,
         )
     with timer.phase("scan chunks (io)"):
         scan = _scan_chunks(in_file, segment_bytes)
@@ -958,6 +1217,159 @@ def repair_file(
             rewrite_checksums(
                 metadata_file_name(in_file), {**scan.crcs, **new_crcs}
             )
+    return targets
+
+
+def _repair_file_multiprocess(
+    in_file: str,
+    *,
+    strategy: str,
+    segment_bytes: int,
+    pipeline_depth: int,
+    mesh,
+    timer: PhaseTimer,
+) -> list[int]:
+    """Multi-host archive repair over a process-spanning mesh (collective).
+
+    The lead process scans chunk health (the CRC pass reads every present
+    chunk once — doing it on all hosts would multiply that IO) and
+    broadcasts the per-chunk state, so every process derives the same
+    survivor subset and rebuild matrix deterministically.  The rebuild GEMM
+    then streams exactly like multi-process encode: each host stages its
+    column span of the survivors, and pwrites its addressable shards of
+    every rebuilt chunk into lead-pre-sized shared-filesystem temps that
+    the lead atomically promotes.  Requirements: shared filesystem,
+    cols-only sharding, w=8.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .ops.gf import get_field
+    from .parallel.mesh import COLS
+    from .parallel.sharded import put_sharded, sharded_gf_matmul
+
+    procs = _mesh_processes(mesh)
+    lead = jax.process_index() == procs[0]
+
+    # Health state: lead scans (CRC IO once, not once per host), peers get
+    # the verdict as a (k+p,) array: 0 = missing, 1 = healthy, 2 = damaged.
+    with timer.phase("scan chunks (io)"):
+        meta = metadata_file_name(in_file)
+        total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
+        if w != 8:
+            raise NotImplementedError("multi-process repair supports w=8 only")
+        if total_mat is None:
+            total_mat = _regenerate_total_matrix(p, k, w)
+        state = np.zeros(k + p, dtype=np.int32)
+        if lead:
+            scan = _scan_chunks(in_file, segment_bytes)
+            state[scan.healthy] = 1
+            state[sorted(scan.bad)] = 2
+        state = np.asarray(
+            multihost_utils.broadcast_one_to_all(state, is_source=lead)
+        )
+    healthy = [int(i) for i in np.flatnonzero(state == 1)]
+    bad = {
+        int(i): chunk_file_name(in_file, int(i))
+        for i in np.flatnonzero(state == 2)
+    }
+    chunk = chunk_size_for(total_size, k, 1)
+    scan_view = _ChunkScan(
+        in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy, bad
+    )
+    targets = scan_view.unhealthy
+    if not targets:
+        return []
+
+    with timer.phase("invert matrix"):
+        chosen, inv = _select_decodable_subset(scan_view)
+        gf = get_field(w)
+        mat = total_mat.astype(gf.dtype)
+        rebuild_mat = gf.matmul(mat[targets], inv)  # (targets, k)
+
+    codec = RSCodec(k, p, w=w, strategy=strategy, mesh=mesh)
+    seg_cols = _segment_cols(chunk, k, segment_bytes)
+    cols_size = mesh.shape[COLS]
+    sharding = NamedSharding(mesh, P(None, COLS))
+    tmp_paths = {t: chunk_file_name(in_file, t) + ".rs_tmp" for t in targets}
+    new_crcs: dict[int, int] = {}
+
+    try:
+        if lead:
+            for t in targets:
+                with open(tmp_paths[t], "wb") as fp:
+                    fp.truncate(chunk)
+        multihost_utils.sync_global_devices("rs_repair_tmps_created")
+
+        surv_fps = [
+            open(chunk_file_name(in_file, i), "rb") for i in chosen
+        ]
+        surv_maps = [
+            np.memmap(chunk_file_name(in_file, i), dtype=np.uint8, mode="r")
+            for i in chosen
+        ]
+        out_fps = {t: open(tmp_paths[t], "r+b") for t in targets}
+        try:
+            stage = _make_padded_stage(
+                surv_fps, surv_maps, chunk, cols_size, sharding, k, timer
+            )
+
+            def drain(tag, rebuilt_sharded) -> None:
+                off, cols = tag
+                with timer.phase("repair compute"):
+                    shards = [
+                        (sh.index[1].start, np.asarray(sh.data))
+                        for sh in rebuilt_sharded.addressable_shards
+                    ]
+                with timer.phase("write chunks (io)"):
+                    for col0, data in shards:
+                        n_cols = min(data.shape[1], cols - col0)
+                        if n_cols <= 0:
+                            continue
+                        for j, t in enumerate(targets):
+                            os.pwrite(
+                                out_fps[t].fileno(),
+                                np.ascontiguousarray(
+                                    data[j, :n_cols]
+                                ).tobytes(),
+                                off + col0,
+                            )
+
+            with SegmentPrefetcher(
+                _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
+            ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+                for (off, cols), local_seg in prefetch:
+                    with timer.phase("repair dispatch"):
+                        Bd = put_sharded(local_seg, mesh, False)
+                        rebuilt = sharded_gf_matmul(
+                            np.asarray(rebuild_mat), Bd,
+                            mesh=mesh, w=w, strategy=codec.strategy,
+                            stripe_sharded=False,
+                        )
+                    window.push((off, cols), rebuilt)
+        finally:
+            for fp in surv_fps:
+                fp.close()
+            for fp in out_fps.values():
+                fp.close()
+        multihost_utils.sync_global_devices("rs_repair_written")
+
+        if lead:
+            if crcs:
+                with timer.phase("write metadata (io)"):
+                    for t in targets:
+                        mm = np.memmap(tmp_paths[t], dtype=np.uint8, mode="r")
+                        new_crcs[t] = chunk_crc32(mm, chunk, segment_bytes)
+            for t in targets:
+                os.replace(tmp_paths[t], chunk_file_name(in_file, t))
+            if crcs:
+                with timer.phase("write metadata (io)"):
+                    rewrite_checksums(meta, {**crcs, **new_crcs})
+    except BaseException:
+        _unlink_shared_tmps(tmp_paths.values())
+        raise
+    multihost_utils.sync_global_devices("rs_repair_promoted")
     return targets
 
 

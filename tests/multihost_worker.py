@@ -101,6 +101,51 @@ def main() -> None:
         assert meta == gmeta, "metadata differs"
     multihost_utils.sync_global_devices("file_layer_checked")
 
+    # --- multi-process decode: drop the first pf natives (worst case —
+    # every stripe needs real recovery), every host stages/computes/writes
+    # only its column spans, output must match the original bytes ----------
+    from gpu_rscode_tpu.utils.fileformat import write_conf
+
+    payload = open(path, "rb").read()
+    conf = os.path.join(workdir, "mp.conf")
+    if pid == 0:
+        survivors = [
+            os.path.basename(chunk_file_name(path, i))
+            for i in range(pf, pf + kf)
+        ]
+        write_conf(conf, survivors)
+        for i in range(pf):
+            os.remove(chunk_file_name(path, i))
+    multihost_utils.sync_global_devices("decode_setup")
+    out = os.path.join(workdir, "recovered.bin")
+    api.decode_file(path, conf, out, mesh=mesh, segment_bytes=128 * 1024)
+    if pid == 0:
+        assert open(out, "rb").read() == payload, "mp decode bytes differ"
+    multihost_utils.sync_global_devices("decode_checked")
+
+    # --- multi-process repair, round 1: the two natives deleted above are
+    # rebuilt in place (p=2 is the archive's loss budget, so corruption
+    # coverage needs a second round) ---------------------------------------
+    rebuilt = api.repair_file(path, mesh=mesh, segment_bytes=128 * 1024)
+    assert sorted(rebuilt) == [0, 1], rebuilt
+
+    # --- round 2: a CRC-detected corrupt parity chunk is rebuilt ----------
+    if pid == 0:
+        with open(chunk_file_name(path, kf + 1), "r+b") as fp:
+            fp.seek(17)
+            byte = fp.read(1)[0]
+            fp.seek(17)
+            fp.write(bytes([byte ^ 0xFF]))
+    multihost_utils.sync_global_devices("repair_round2_setup")
+    rebuilt = api.repair_file(path, mesh=mesh, segment_bytes=128 * 1024)
+    assert rebuilt == [kf + 1], rebuilt
+    if pid == 0:
+        for i in range(kf + pf):
+            a = open(chunk_file_name(path, i), "rb").read()
+            b = open(chunk_file_name(gpath, i), "rb").read()
+            assert a == b, f"repaired chunk {i} differs from golden"
+    multihost_utils.sync_global_devices("repair_checked")
+
     print("MULTIHOST_OK", flush=True)
 
 
