@@ -318,7 +318,9 @@ def _encode_file_multiprocess(
     ``make_array_from_process_local_data`` (put_sharded's multi-process
     branch), the sharded GEMM runs collectively, and each host writes only
     its addressable output shards into the shared-filesystem chunk files.
-    Requirements: a shared filesystem, cols-only sharding, w=8.
+    Requirements: a shared filesystem and cols-only sharding (w=8 and the
+    w=16 wide-symbol extension both work; device columns are whole
+    symbols, so w=16 byte offsets are 2x the sharding's symbol spans).
 
     All processes must call encode_file with the same arguments (it is a
     collective).  The lead process (lowest process index in the mesh)
@@ -335,13 +337,12 @@ def _encode_file_multiprocess(
 
     mesh = codec.mesh
     k, p = codec.native_num, codec.parity_num
+    sym = codec.w // 8
     if codec.stripe_sharded:
         raise NotImplementedError(
             "multi-process file encode shards the cols axis only "
             "(stripe_sharded=True is a single-process mesh feature)"
         )
-    if codec.w != 8:
-        raise NotImplementedError("multi-process file encode supports w=8 only")
 
     lead = jax.process_index() == min(
         d.process_index for d in mesh.devices.flat
@@ -375,16 +376,18 @@ def _encode_file_multiprocess(
         multihost_utils.sync_global_devices("rs_encode_files_created")
 
         def stage(off: int, cols: int):
-            # Padded global width (equal per-device shards for
+            # Padded global width in SYMBOLS (equal per-device shards for
             # make_array_from_process_local_data); parity of the zero pad is
             # zero and is trimmed at write time.
-            W = ((cols + cols_size - 1) // cols_size) * cols_size
+            cols_s = cols // sym
+            W = ((cols_s + cols_size - 1) // cols_size) * cols_size
             lo, hi = _local_col_span(sharding, k, W)
             with timer.phase("stage segment (io)"):
-                return native.stripe_read(
-                    file_name, chunk, k, off + lo, hi - lo, total_size,
-                    fallback_src=src,
+                seg = native.stripe_read(
+                    file_name, chunk, k, off + lo * sym, (hi - lo) * sym,
+                    total_size, fallback_src=src,
                 )
+                return seg.view(np.uint16) if sym == 2 else seg
 
         parity_fps = [open(tmps[name], "r+b") for name in parity_names]
         try:
@@ -392,21 +395,13 @@ def _encode_file_multiprocess(
             def drain(tag, parity_sharded) -> None:
                 off, cols = tag
                 with timer.phase("encode compute"):
-                    shards = [
-                        (sh.index[1].start, np.asarray(sh.data))
-                        for sh in parity_sharded.addressable_shards
-                    ]
+                    shards = _trimmed_shards(parity_sharded, cols, sym)
                 with timer.phase("write parity (io)"):
                     for col0, data in shards:
-                        n_cols = min(data.shape[1], cols - col0)
-                        if n_cols <= 0:
-                            continue
                         for j in range(p):
                             os.pwrite(
                                 parity_fps[j].fileno(),
-                                np.ascontiguousarray(
-                                    data[j, :n_cols]
-                                ).tobytes(),
+                                data[j].tobytes(),
                                 off + col0,
                             )
 
@@ -682,27 +677,53 @@ def _local_col_span(sharding, k: int, W: int) -> tuple[int, int]:
     return lo, hi
 
 
-def _make_padded_stage(fps, maps, chunk, cols_size, sharding, k, timer):
+def _make_padded_stage(fps, maps, chunk, cols_size, sharding, k, timer, sym=1):
     """Segment stager shared by the multi-process decode and repair
     collectives: reads this process's column span of the k survivor files,
     zero-filling the pad columns past the chunk end (equal per-device
     shards need the padded width; the pad's decoded garbage is dropped by
-    the trimmed writes)."""
+    the trimmed writes).  Sharding spans are in SYMBOL units (``sym``
+    bytes each — 2 for w=16, whose segments come back as uint16 views);
+    the file reads convert back to byte offsets."""
     from . import native
 
     def stage(off: int, cols: int):
-        W = ((cols + cols_size - 1) // cols_size) * cols_size
+        off_s, cols_s, chunk_s = off // sym, cols // sym, chunk // sym
+        W = ((cols_s + cols_size - 1) // cols_size) * cols_size
         lo, hi = _local_col_span(sharding, k, W)
-        readable = max(0, min(off + hi, chunk) - (off + lo))
+        readable = max(0, min(off_s + hi, chunk_s) - (off_s + lo))
         with timer.phase("stage segment (io)"):
-            seg = np.zeros((k, hi - lo), dtype=np.uint8)
+            seg = np.zeros((k, (hi - lo) * sym), dtype=np.uint8)
             if readable:
-                seg[:, :readable] = native.gather_rows(
-                    fps, off + lo, readable, fallback_maps=maps
+                seg[:, : readable * sym] = native.gather_rows(
+                    fps, (off_s + lo) * sym, readable * sym,
+                    fallback_maps=maps,
                 )
-            return seg
+            return seg.view(np.uint16) if sym == 2 else seg
 
     return stage
+
+
+def _trimmed_shards(sharded, cols: int, sym: int = 1):
+    """Materialise the addressable shards of a cols-sharded GEMM output as
+    ``(byte_col0, uint8 rows)`` pairs, trimmed to the segment's real width
+    (the zero-pad columns staged for equal per-device shards are dropped
+    here).  Blocks on the device; callers time it under their compute
+    phase.  ``sym``-byte symbols are flattened to little-endian bytes, the
+    chunk-file byte order."""
+    out = []
+    cols_s = cols // sym
+    for sh in sharded.addressable_shards:
+        col0 = sh.index[1].start
+        data = np.asarray(sh.data)
+        n_cols = min(data.shape[1], cols_s - col0)
+        if n_cols <= 0:
+            continue
+        rows = np.ascontiguousarray(data[:, :n_cols])
+        if rows.dtype != np.uint8:
+            rows = rows.view(np.uint8)
+        out.append((col0 * sym, rows))
+    return out
 
 
 def _unlink_shared_tmps(paths) -> None:
@@ -740,8 +761,8 @@ def _decode_file_multiprocess(
     device).  The checksum pre-pass runs on the lead only and its verdict
     is broadcast, so a corrupt survivor raises the same
     :class:`ChunkIntegrityError` on every process instead of wedging peers
-    at a barrier.  Requirements: shared filesystem, cols-only sharding,
-    w=8 (same contract as multi-process encode).
+    at a barrier.  Requirements: shared filesystem and cols-only sharding,
+    w=8 or w=16 (same contract as multi-process encode).
     """
     import jax
     from jax.experimental import multihost_utils
@@ -757,8 +778,12 @@ def _decode_file_multiprocess(
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(
             metadata_file_name(in_file)
         )
-    if w != 8:
-        raise NotImplementedError("multi-process file decode supports w=8 only")
+    if w not in (8, 16):
+        raise ValueError(
+            f"unsupported gfwidth {w} in {metadata_file_name(in_file)!r} "
+            "(this build decodes w=8 and w=16 files)"
+        )
+    sym = w // 8
     if total_mat is None:
         total_mat = _regenerate_total_matrix(p, k, w)
     if int(total_mat.max(initial=0)) >= (1 << w):
@@ -766,7 +791,7 @@ def _decode_file_multiprocess(
             f"metadata matrix entry {int(total_mat.max())} out of range for "
             f"GF(2^{w}) — corrupt or foreign .METADATA"
         )
-    chunk = chunk_size_for(total_size, k, 1)
+    chunk = chunk_size_for(total_size, k, sym)
     names = read_conf(conf_file)
     if len(names) != k:
         raise ValueError(f"conf file lists {len(names)} chunks, need k={k}")
@@ -881,23 +906,17 @@ def _decode_file_multiprocess(
 
             if dec_missing is not None:
                 stage = _make_padded_stage(
-                    fps, maps, chunk, cols_size, sharding, k, timer
+                    fps, maps, chunk, cols_size, sharding, k, timer, sym
                 )
 
                 def drain(tag, rec_sharded) -> None:
                     off, cols = tag
                     with timer.phase("decode compute"):
-                        shards = [
-                            (sh.index[1].start, np.asarray(sh.data))
-                            for sh in rec_sharded.addressable_shards
-                        ]
+                        shards = _trimmed_shards(rec_sharded, cols, sym)
                     with timer.phase("write output (io)"):
                         for col0, data in shards:
-                            n_cols = min(data.shape[1], cols - col0)
-                            if n_cols <= 0:
-                                continue
                             for j, i in enumerate(missing):
-                                pwrite_row(i, off + col0, data[j, :n_cols])
+                                pwrite_row(i, off + col0, data[j])
 
                 with SegmentPrefetcher(
                     _segment_spans(chunk, seg_cols), stage,
@@ -1238,8 +1257,8 @@ def _repair_file_multiprocess(
     then streams exactly like multi-process encode: each host stages its
     column span of the survivors, and pwrites its addressable shards of
     every rebuilt chunk into lead-pre-sized shared-filesystem temps that
-    the lead atomically promotes.  Requirements: shared filesystem,
-    cols-only sharding, w=8.
+    the lead atomically promotes.  Requirements: shared filesystem and
+    cols-only sharding, w=8 or w=16.
     """
     import jax
     from jax.experimental import multihost_utils
@@ -1257,8 +1276,11 @@ def _repair_file_multiprocess(
     with timer.phase("scan chunks (io)"):
         meta = metadata_file_name(in_file)
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
-        if w != 8:
-            raise NotImplementedError("multi-process repair supports w=8 only")
+        if w not in (8, 16):
+            raise ValueError(
+                f"unsupported gfwidth {w} in {meta!r} (this build handles 8/16)"
+            )
+        sym = w // 8
         if total_mat is None:
             total_mat = _regenerate_total_matrix(p, k, w)
         state = np.zeros(k + p, dtype=np.int32)
@@ -1274,7 +1296,7 @@ def _repair_file_multiprocess(
         int(i): chunk_file_name(in_file, int(i))
         for i in np.flatnonzero(state == 2)
     }
-    chunk = chunk_size_for(total_size, k, 1)
+    chunk = chunk_size_for(total_size, k, sym)
     scan_view = _ChunkScan(
         in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy, bad
     )
@@ -1312,27 +1334,19 @@ def _repair_file_multiprocess(
         out_fps = {t: open(tmp_paths[t], "r+b") for t in targets}
         try:
             stage = _make_padded_stage(
-                surv_fps, surv_maps, chunk, cols_size, sharding, k, timer
+                surv_fps, surv_maps, chunk, cols_size, sharding, k, timer, sym
             )
 
             def drain(tag, rebuilt_sharded) -> None:
                 off, cols = tag
                 with timer.phase("repair compute"):
-                    shards = [
-                        (sh.index[1].start, np.asarray(sh.data))
-                        for sh in rebuilt_sharded.addressable_shards
-                    ]
+                    shards = _trimmed_shards(rebuilt_sharded, cols, sym)
                 with timer.phase("write chunks (io)"):
                     for col0, data in shards:
-                        n_cols = min(data.shape[1], cols - col0)
-                        if n_cols <= 0:
-                            continue
                         for j, t in enumerate(targets):
                             os.pwrite(
                                 out_fps[t].fileno(),
-                                np.ascontiguousarray(
-                                    data[j, :n_cols]
-                                ).tobytes(),
+                                data[j].tobytes(),
                                 off + col0,
                             )
 

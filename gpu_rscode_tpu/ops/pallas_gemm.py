@@ -27,10 +27,10 @@ masked output write Pallas performs automatically.
 
 Three bit-expansion formulations (``expand``), all bit-verified in interpret
 mode; the committed 2026-07-30 v5e captures (bench_captures/) show the kernel
-is compute-bound on the expansion — compute-only ceiling ~63.5 GB/s vs a DMA
-floor measured between 87 and 181 GB/s across runs (tunnel jitter), kernel
-end-to-end 64.3-64.6 GB/s at tile 16384/32768
-(bench_captures/tile_pick_tpu_*.jsonl, kernel_sweep_tpu_*.jsonl):
+is compute-bound on the expansion — compute-only ceiling 64.9 GB/s vs a DMA
+floor of 286 GB/s (both at 320 MB calls, kernel_floors_tpu_*.jsonl), kernel
+end-to-end 64.3-64.8 GB/s at tile 16384
+(bench_tpu_*.json, tile_pick_tpu_*.jsonl):
 
 * ``"shift"`` — plane s = (b >> s) & 1 in int32 lanes (proven default).
 * ``"sign"``  — plane s = (int_w)(b << (w-1-s)) >> (w-1), i.e. {0, -1},

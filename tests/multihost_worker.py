@@ -146,6 +146,58 @@ def main() -> None:
             assert a == b, f"repaired chunk {i} differs from golden"
     multihost_utils.sync_global_devices("repair_checked")
 
+    # --- wide-symbol (w=16) file collectives: byte offsets are 2x the
+    # sharding's symbol spans — encode vs single-process golden, worst-case
+    # decode, and repair of the decode-test erasures ------------------------
+    w16dir = os.path.join(workdir, "w16")
+    wpath = os.path.join(w16dir, "payload.bin")
+    if pid == 0:
+        os.makedirs(w16dir, exist_ok=True)
+        with open(wpath, "wb") as fp:
+            fp.write(payload)
+    multihost_utils.sync_global_devices("w16_setup")
+    api.encode_file(
+        wpath, kf, pf, mesh=mesh, w=16, checksums=True,
+        segment_bytes=128 * 1024,
+    )
+    if pid == 0:
+        g16dir = os.path.join(workdir, "golden16")
+        os.makedirs(g16dir, exist_ok=True)
+        g16 = os.path.join(g16dir, "payload.bin")
+        with open(g16, "wb") as fp:
+            fp.write(payload)
+        api.encode_file(g16, kf, pf, w=16, checksums=True)
+        for i in range(kf + pf):
+            a = open(chunk_file_name(wpath, i), "rb").read()
+            b = open(chunk_file_name(g16, i), "rb").read()
+            assert a == b, f"w16 chunk {i} differs between 2-process and single"
+    multihost_utils.sync_global_devices("w16_encode_checked")
+
+    conf16 = os.path.join(w16dir, "mp16.conf")
+    if pid == 0:
+        write_conf(conf16, [
+            os.path.basename(chunk_file_name(wpath, i))
+            for i in range(pf, pf + kf)
+        ])
+        for i in range(pf):
+            os.remove(chunk_file_name(wpath, i))
+    multihost_utils.sync_global_devices("w16_decode_setup")
+    out16 = os.path.join(workdir, "recovered16.bin")
+    api.decode_file(wpath, conf16, out16, mesh=mesh, segment_bytes=128 * 1024)
+    if pid == 0:
+        assert open(out16, "rb").read() == payload, "w16 mp decode differs"
+    multihost_utils.sync_global_devices("w16_decode_checked")
+
+    rebuilt = api.repair_file(wpath, mesh=mesh, segment_bytes=128 * 1024)
+    assert sorted(rebuilt) == [0, 1], rebuilt
+    if pid == 0:
+        g16 = os.path.join(workdir, "golden16", "payload.bin")
+        for i in range(kf + pf):
+            a = open(chunk_file_name(wpath, i), "rb").read()
+            b = open(chunk_file_name(g16, i), "rb").read()
+            assert a == b, f"w16 repaired chunk {i} differs from golden"
+    multihost_utils.sync_global_devices("w16_repair_checked")
+
     print("MULTIHOST_OK", flush=True)
 
 
