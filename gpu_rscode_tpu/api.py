@@ -93,6 +93,16 @@ def _segment_spans(chunk_size: int, seg_cols: int) -> list[tuple[int, int]]:
     return spans
 
 
+def _check_gfwidth(w: int, meta_path: str) -> None:
+    """Reject metadata symbol widths this build does not code for (every
+    entry point that reads .METADATA validates before using ``w``)."""
+    if w not in (8, 16):
+        raise ValueError(
+            f"unsupported gfwidth {w} in {meta_path!r} "
+            "(this build handles w=8 and w=16 files)"
+        )
+
+
 def _mesh_processes(mesh) -> list[int]:
     """Sorted process indices a mesh's devices span ([] for mesh=None)."""
     if mesh is None:
@@ -501,11 +511,7 @@ def decode_file(
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(
             metadata_file_name(in_file)
         )
-    if w not in (8, 16):
-        raise ValueError(
-            f"unsupported gfwidth {w} in {metadata_file_name(in_file)!r} "
-            "(this build decodes w=8 and w=16 files)"
-        )
+    _check_gfwidth(w, metadata_file_name(in_file))
     if total_mat is None:
         total_mat = _regenerate_total_matrix(p, k, w)
     if int(total_mat.max(initial=0)) >= (1 << w):
@@ -778,11 +784,7 @@ def _decode_file_multiprocess(
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(
             metadata_file_name(in_file)
         )
-    if w not in (8, 16):
-        raise ValueError(
-            f"unsupported gfwidth {w} in {metadata_file_name(in_file)!r} "
-            "(this build decodes w=8 and w=16 files)"
-        )
+    _check_gfwidth(w, metadata_file_name(in_file))
     sym = w // 8
     if total_mat is None:
         total_mat = _regenerate_total_matrix(p, k, w)
@@ -984,10 +986,7 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
     """Discover chunk health next to ``in_file`` (size + CRC checks)."""
     meta = metadata_file_name(in_file)
     total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
-    if w not in (8, 16):
-        raise ValueError(
-            f"unsupported gfwidth {w} in {meta!r} (this build handles 8/16)"
-        )
+    _check_gfwidth(w, meta)
     if total_mat is None:
         total_mat = _regenerate_total_matrix(p, k, w)
     if int(total_mat.max(initial=0)) >= (1 << w):
@@ -1276,10 +1275,7 @@ def _repair_file_multiprocess(
     with timer.phase("scan chunks (io)"):
         meta = metadata_file_name(in_file)
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
-        if w not in (8, 16):
-            raise ValueError(
-                f"unsupported gfwidth {w} in {meta!r} (this build handles 8/16)"
-            )
+        _check_gfwidth(w, meta)
         sym = w // 8
         if total_mat is None:
             total_mat = _regenerate_total_matrix(p, k, w)

@@ -198,6 +198,42 @@ def main() -> None:
             assert a == b, f"w16 repaired chunk {i} differs from golden"
     multihost_utils.sync_global_devices("w16_repair_checked")
 
+    # --- all-natives mp decode: no missing rows, so no GEMM runs at all —
+    # just the round-robin passthrough copies across hosts -----------------
+    conf_nat = os.path.join(workdir, "natives.conf")
+    if pid == 0:
+        write_conf(conf_nat, [
+            os.path.basename(chunk_file_name(path, i)) for i in range(kf)
+        ])
+    multihost_utils.sync_global_devices("allnat_setup")
+    out_nat = os.path.join(workdir, "recovered_nat.bin")
+    api.decode_file(path, conf_nat, out_nat, mesh=mesh,
+                    segment_bytes=128 * 1024)
+    if pid == 0:
+        assert open(out_nat, "rb").read() == payload, "all-natives mp decode"
+    multihost_utils.sync_global_devices("allnat_checked")
+
+    # --- lockstep integrity failure: a corrupt survivor must raise
+    # ChunkIntegrityError on EVERY process (lead verdict broadcast), naming
+    # the bad chunk, with no .rs_tmp left behind ----------------------------
+    if pid == 0:
+        with open(chunk_file_name(path, 2), "r+b") as fp:
+            fp.seek(5)
+            byte = fp.read(1)[0]
+            fp.seek(5)
+            fp.write(bytes([byte ^ 0xFF]))
+    multihost_utils.sync_global_devices("corrupt_setup")
+    try:
+        api.decode_file(
+            path, conf_nat, os.path.join(workdir, "never.bin"),
+            mesh=mesh, segment_bytes=128 * 1024,
+        )
+        raise AssertionError("corrupt survivor decoded without error")
+    except api.ChunkIntegrityError as e:
+        assert 2 in e.bad_chunks, e.bad_chunks
+    assert not os.path.exists(os.path.join(workdir, "never.bin.rs_tmp"))
+    multihost_utils.sync_global_devices("corrupt_checked")
+
     print("MULTIHOST_OK", flush=True)
 
 

@@ -1,0 +1,129 @@
+"""Single-process unit tests for the multi-process collective helpers.
+
+The 2-process integration test (test_multihost.py) exercises these end to
+end; these tests pin their unit behavior on the 8-device virtual mesh so a
+regression is localised here instead of surfacing as a byte-diff two
+processes deep.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from gpu_rscode_tpu.api import (
+    _local_col_span,
+    _make_padded_stage,
+    _trimmed_shards,
+    _unlink_shared_tmps,
+)
+from gpu_rscode_tpu.parallel.mesh import COLS, make_mesh
+from gpu_rscode_tpu.utils.timing import PhaseTimer
+
+
+def _cols_sharding(mesh):
+    return NamedSharding(mesh, P(None, COLS))
+
+
+def test_local_col_span_covers_all_columns_disjointly():
+    # Single process: the "local" span is the whole width, and the span
+    # arithmetic must be exact for any 128-aligned W.
+    mesh = make_mesh(8)
+    sharding = _cols_sharding(mesh)
+    for W in (1024, 8 * 128, 8 * 4096):
+        lo, hi = _local_col_span(sharding, 4, W)
+        assert (lo, hi) == (0, W)
+
+
+def test_padded_stage_zero_fills_past_chunk(tmp_path):
+    # chunk=300 bytes, segment asks for the tail span [256, 300); the
+    # padded width rounds to a multiple of cols_size=8 symbols, and the
+    # overhang must come back as zeros, not garbage or a short read.
+    k, chunk = 3, 300
+    rng = np.random.default_rng(0)
+    rows = rng.integers(0, 256, size=(k, chunk), dtype=np.uint8)
+    paths = []
+    for i in range(k):
+        p = tmp_path / f"c{i}"
+        p.write_bytes(rows[i].tobytes())
+        paths.append(str(p))
+    mesh = make_mesh(8)
+    sharding = _cols_sharding(mesh)
+    fps = [open(p, "rb") for p in paths]
+    maps = [np.memmap(p, dtype=np.uint8, mode="r") for p in paths]
+    try:
+        stage = _make_padded_stage(
+            fps, maps, chunk, mesh.shape[COLS], sharding, k, PhaseTimer(False)
+        )
+        off, cols = 256, chunk - 256  # ragged tail: 44 cols -> W = 48
+        seg = stage(off, cols)
+        W = ((cols + 7) // 8) * 8
+        assert seg.shape == (k, W)
+        assert np.array_equal(seg[:, :cols], rows[:, off:])
+        assert not seg[:, cols:].any()
+    finally:
+        for fp in fps:
+            fp.close()
+
+
+def test_padded_stage_w16_returns_uint16_symbol_views(tmp_path):
+    k, chunk = 2, 64  # bytes; 32 uint16 symbols
+    rng = np.random.default_rng(1)
+    rows = rng.integers(0, 256, size=(k, chunk), dtype=np.uint8)
+    paths = []
+    for i in range(k):
+        p = tmp_path / f"c{i}"
+        p.write_bytes(rows[i].tobytes())
+        paths.append(str(p))
+    mesh = make_mesh(8)
+    sharding = _cols_sharding(mesh)
+    fps = [open(p, "rb") for p in paths]
+    maps = [np.memmap(p, dtype=np.uint8, mode="r") for p in paths]
+    try:
+        stage = _make_padded_stage(
+            fps, maps, chunk, mesh.shape[COLS], sharding, k,
+            PhaseTimer(False), sym=2,
+        )
+        seg = stage(0, chunk)
+        assert seg.dtype == np.uint16
+        assert np.array_equal(
+            seg, rows.copy().view(np.uint16)
+        )  # little-endian byte pairing preserved
+    finally:
+        for fp in fps:
+            fp.close()
+
+
+@pytest.mark.parametrize("sym", [1, 2])
+def test_trimmed_shards_drop_pad_and_flatten_symbols(sym):
+    # Global width 16 symbols over 8 devices; the segment's real width is
+    # 13 symbols, so the last shard must come back trimmed and every
+    # shard's offset converted to bytes.
+    mesh = make_mesh(8)
+    dtype = np.uint8 if sym == 1 else np.uint16
+    rng = np.random.default_rng(2)
+    W, real = 16, 13
+    host = rng.integers(0, 2 ** (8 * sym), size=(2, W)).astype(dtype)
+    arr = jax.device_put(host, _cols_sharding(mesh))
+    shards = _trimmed_shards(arr, real * sym, sym)
+    got = np.zeros((2, real * sym), dtype=np.uint8)
+    seen = 0
+    for col0, data in shards:
+        assert data.dtype == np.uint8
+        got[:, col0 : col0 + data.shape[1]] = data
+        seen += data.shape[1]
+    assert seen == real * sym
+    want = np.ascontiguousarray(host[:, :real])
+    want8 = want if sym == 1 else want.view(np.uint8)
+    assert np.array_equal(got, want8)
+
+
+def test_unlink_shared_tmps_tolerates_losing_the_race(tmp_path):
+    present = tmp_path / "a.rs_tmp"
+    present.write_bytes(b"x")
+    missing = tmp_path / "gone.rs_tmp"  # a peer already unlinked this one
+    _unlink_shared_tmps([str(present), str(missing)])
+    assert not present.exists()
+    assert not os.path.exists(str(missing))
