@@ -15,6 +15,7 @@ reference's kernel-bandwidth figure (which likewise excludes PCIe copies).
 
 import json
 import sys
+import threading
 import time as _time_mod
 
 import numpy as np
@@ -28,10 +29,26 @@ def _mark(phase: str) -> None:
     print(f"# [{_time_mod.time() - _T0:7.1f}s] {phase}", file=sys.stderr, flush=True)
 
 
+# One-line contract, enforced: success, failure, second-chance forward and
+# the wedge watchdog all race to this gate; the first wins, the rest no-op.
+_EMIT_LOCK = threading.Lock()
+_EMITTED = False
+
+
+def _emit_line(line: str) -> bool:
+    global _EMITTED
+    with _EMIT_LOCK:
+        if _EMITTED:
+            return False
+        _EMITTED = True
+    print(line, flush=True)
+    return True
+
+
 def _emit(backend: str, value: float, detail: dict) -> None:
     """The bench's single machine-readable output line — one schema, used by
     the success, strategy-failure and crash paths alike."""
-    print(
+    _emit_line(
         json.dumps(
             {
                 "metric": f"encode_bandwidth_k{K}_n{K + P}_{backend}",
@@ -42,6 +59,71 @@ def _emit(backend: str, value: float, detail: dict) -> None:
             }
         )
     )
+
+
+def _committed_tpu_captures() -> list:
+    import glob
+    import os
+
+    return sorted(
+        glob.glob(
+            os.path.join(os.path.dirname(__file__) or ".",
+                         "bench_captures", "bench_tpu_*.json")
+        )
+    )
+
+
+def _arm_wedge_watchdog() -> None:
+    """Emit the JSON line even if the device WEDGES mid-measurement.
+
+    The probe protects against a tunnel that is down at start; this guards
+    the TOCTOU hole after it: a healthy probe followed by a mid-run hang
+    blocks the main thread inside a device wait, where neither exception
+    handlers nor signal handlers can run — observed 2026-07-30 as an rc=124
+    bench with NO output line.  A daemon timer fires from its own thread
+    before any plausible driver timeout, emits the error line (pointing at
+    the committed hardware captures) and hard-exits.  Skipped in the
+    second-chance child: its parent holds a result line already.
+    """
+    import os
+
+    budget = float(os.environ.get("RS_BENCH_WATCHDOG_S", "480"))
+
+    def fire() -> None:
+        if _emit_line(
+            json.dumps(
+                {
+                    "metric": f"encode_bandwidth_k{K}_n{K + P}_error",
+                    "value": 0.0,
+                    "unit": "GB/s",
+                    "vs_baseline": 0.0,
+                    "detail": {
+                        "error": f"watchdog: no result after {budget:.0f}s "
+                                 "(device wedged mid-run?)",
+                        "committed_tpu_captures": _committed_tpu_captures(),
+                    },
+                }
+            )
+        ):
+            _mark("watchdog fired; device wedged mid-run")
+            os._exit(1)
+
+    if not os.environ.get("RS_BENCH_NO_FALLBACK"):
+        global _WATCHDOG
+        _WATCHDOG = threading.Timer(budget, fire)
+        _WATCHDOG.daemon = True
+        _WATCHDOG.start()
+
+
+_WATCHDOG = None
+
+
+def _disarm_wedge_watchdog() -> None:
+    """Called once a measurement is safely in hand: everything after that
+    point is host-side with subprocess timeouts (the second-chance path can
+    legitimately run ~6 min), so the watchdog must not race the final emit."""
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()
 
 from gpu_rscode_tpu.tools._bench_timing import time_device_fn as _time
 
@@ -219,8 +301,7 @@ def _second_chance_tpu() -> bool:
             if line.startswith("{") and "_tpu" in line.split(",")[0]:
                 try:
                     if json.loads(line).get("value", 0) > 0:
-                        print(line)
-                        return True
+                        return _emit_line(line)
                 except ValueError:
                     pass
     _mark(f"second-chance run rc={run.returncode} had no good TPU line; keeping cpu line")
@@ -236,6 +317,7 @@ def _verify(small_fn, oracle_slice):
 
 
 def main() -> None:
+    _arm_wedge_watchdog()
     _mark("backend init")
     jax, backend = _init_backend()
     _mark(f"backend ready: {backend}")
@@ -342,6 +424,8 @@ def main() -> None:
     except Exception as e:
         detail["decode"] = f"failed: {type(e).__name__}"
     _mark("done")
+    # Result in hand; all remaining work is host-side and time-bounded.
+    _disarm_wedge_watchdog()
     # (backend was relabelled "tpu" above whenever the devices are real TPU
     # chips, however the tunnel registers itself — this guard only fires for
     # genuine CPU fallbacks.  The child never takes a second chance itself.)
@@ -353,6 +437,13 @@ def main() -> None:
         and _second_chance_tpu()
     ):
         return  # the forwarded TPU line is the bench's single output line
+    if backend != "tpu":
+        # A CPU line means the tunnel was down for this run, not that no TPU
+        # number exists — point readers of the artifact at the committed
+        # same-config hardware captures.
+        caps = _committed_tpu_captures()
+        if caps:
+            detail["committed_tpu_captures"] = caps
     _emit(backend, best[1], {"strategy": best[0], **detail})
 
 
