@@ -45,10 +45,11 @@ def _emit_line(line: str) -> bool:
     return True
 
 
-def _emit(backend: str, value: float, detail: dict) -> None:
+def _emit(backend: str, value: float, detail: dict) -> bool:
     """The bench's single machine-readable output line — one schema, used by
-    the success, strategy-failure and crash paths alike."""
-    _emit_line(
+    the success, strategy-failure, crash and watchdog paths alike.  Returns
+    whether THIS call won the one-line gate."""
+    return _emit_line(
         json.dumps(
             {
                 "metric": f"encode_bandwidth_k{K}_n{K + P}_{backend}",
@@ -73,6 +74,9 @@ def _committed_tpu_captures() -> list:
     )
 
 
+_PARTIAL = None  # (backend, best, detail) once a VERIFIED number exists
+
+
 def _arm_wedge_watchdog() -> None:
     """Emit the JSON line even if the device WEDGES mid-measurement.
 
@@ -81,49 +85,55 @@ def _arm_wedge_watchdog() -> None:
     blocks the main thread inside a device wait, where neither exception
     handlers nor signal handlers can run — observed 2026-07-30 as an rc=124
     bench with NO output line.  A daemon timer fires from its own thread
-    before any plausible driver timeout, emits the error line (pointing at
-    the committed hardware captures) and hard-exits.  Skipped in the
-    second-chance child: its parent holds a result line already.
+    before any plausible driver timeout and hard-exits after emitting:
+
+    * the held result (exit 0) when a verified encode number is already in
+      hand (``_PARTIAL``, set the moment the strategy race concludes) — a
+      wedge during decode timing or a long second-chance phase must not
+      discard the round's headline measurement;
+    * otherwise the error line with pointers to the committed hardware
+      captures (exit 1).
+
+    Armed unconditionally: in the second-chance child the parent's 300 s
+    subprocess timeout expires long before this fires, and a direct
+    hardware-only run (RS_BENCH_NO_FALLBACK) is the MOST exposed to a
+    wedge, not the least.
     """
     import os
 
     budget = float(os.environ.get("RS_BENCH_WATCHDOG_S", "480"))
 
     def fire() -> None:
-        if _emit_line(
-            json.dumps(
+        if _PARTIAL is not None:
+            backend, best, detail = _PARTIAL
+            if _emit(
+                backend, best[1],
                 {
-                    "metric": f"encode_bandwidth_k{K}_n{K + P}_error",
-                    "value": 0.0,
-                    "unit": "GB/s",
-                    "vs_baseline": 0.0,
-                    "detail": {
-                        "error": f"watchdog: no result after {budget:.0f}s "
-                                 "(device wedged mid-run?)",
-                        "committed_tpu_captures": _committed_tpu_captures(),
-                    },
-                }
-            )
+                    "strategy": best[0], **detail,
+                    "watchdog": "fired before the run fully completed; "
+                                "value is the verified encode measurement",
+                },
+            ):
+                _mark("watchdog fired; emitted the held result")
+                os._exit(0)
+        elif _emit(
+            "error", 0.0,
+            {
+                "error": f"watchdog: no result after {budget:.0f}s "
+                         "(device wedged mid-run?)",
+                "committed_tpu_captures": _committed_tpu_captures(),
+            },
         ):
             _mark("watchdog fired; device wedged mid-run")
             os._exit(1)
 
-    if not os.environ.get("RS_BENCH_NO_FALLBACK"):
-        global _WATCHDOG
-        _WATCHDOG = threading.Timer(budget, fire)
-        _WATCHDOG.daemon = True
-        _WATCHDOG.start()
+    global _WATCHDOG
+    _WATCHDOG = threading.Timer(budget, fire)
+    _WATCHDOG.daemon = True
+    _WATCHDOG.start()
 
 
 _WATCHDOG = None
-
-
-def _disarm_wedge_watchdog() -> None:
-    """Called once a measurement is safely in hand: everything after that
-    point is host-side with subprocess timeouts (the second-chance path can
-    legitimately run ~6 min), so the watchdog must not race the final emit."""
-    if _WATCHDOG is not None:
-        _WATCHDOG.cancel()
 
 from gpu_rscode_tpu.tools._bench_timing import time_device_fn as _time
 
@@ -390,6 +400,12 @@ def main() -> None:
         _emit(backend, 0.0, {"error": "all strategies failed", **detail})
         raise SystemExit(1)
 
+    # Headline number verified and in hand: from here on the wedge watchdog
+    # emits THIS (decode keys accumulate into the same detail dict) instead
+    # of a value-0 error line.
+    global _PARTIAL
+    _PARTIAL = (backend, best, detail)
+
     # 4-erasure recovery latency (BASELINE's second headline): reconstruct
     # the P lost natives from the surviving k chunks with the best strategy.
     from gpu_rscode_tpu.models.vandermonde import total_matrix
@@ -424,8 +440,6 @@ def main() -> None:
     except Exception as e:
         detail["decode"] = f"failed: {type(e).__name__}"
     _mark("done")
-    # Result in hand; all remaining work is host-side and time-bounded.
-    _disarm_wedge_watchdog()
     # (backend was relabelled "tpu" above whenever the devices are real TPU
     # chips, however the tunnel registers itself — this guard only fires for
     # genuine CPU fallbacks.  The child never takes a second chance itself.)

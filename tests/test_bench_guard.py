@@ -1,0 +1,111 @@
+"""Regression tests for bench.py's output-line guarantees.
+
+bench.py is the round's one driver-captured artifact; these tests pin the
+guard rails that keep its single-JSON-line contract alive through the
+tunnel failure modes observed across rounds (no line on rc=1, a CPU line
+masking a TPU capability, and — round 3 — a mid-run wedge producing
+rc=124 with NO line at all).
+"""
+
+import importlib.util
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench():
+    spec = importlib.util.spec_from_file_location(
+        "benchmod", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_emit_line_is_first_wins():
+    m = _load_bench()
+    assert m._emit_line("one") is True
+    assert m._emit_line("two") is False  # the contract: exactly one line
+
+
+def test_emit_goes_through_the_gate(capsys):
+    m = _load_bench()
+    m._emit("cpu", 1.0, {"a": 1})
+    m._emit("tpu", 2.0, {"b": 2})  # must be swallowed
+    out = [l for l in capsys.readouterr().out.splitlines() if l.strip()]
+    assert len(out) == 1
+    assert json.loads(out[0])["metric"].endswith("_cpu")
+
+
+def test_committed_tpu_captures_lists_repo_artifacts():
+    m = _load_bench()
+    caps = m._committed_tpu_captures()
+    # The round-3 hardware captures are committed; the bench must find them
+    # regardless of the caller's cwd (it anchors on bench.py's directory).
+    assert caps, "no bench_tpu_*.json captures found"
+    assert all(os.path.basename(c).startswith("bench_tpu_") for c in caps)
+
+
+def test_watchdog_emits_error_line_and_exits():
+    # Fire-path needs os._exit, so run it in a child interpreter.
+    code = (
+        "import importlib.util, time, sys\n"
+        f"spec = importlib.util.spec_from_file_location('b', {os.path.join(REPO, 'bench.py')!r})\n"
+        "m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)\n"
+        "m._arm_wedge_watchdog()\n"
+        "time.sleep(10)\n"
+        "print('WEDGE NEVER BROKEN')\n"
+    )
+    env = dict(os.environ, RS_BENCH_WATCHDOG_S="1", PYTHONPATH="")
+    env.pop("RS_BENCH_NO_FALLBACK", None)
+    run = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=30,
+    )
+    assert run.returncode == 1
+    line = json.loads(run.stdout.strip().splitlines()[0])
+    assert "watchdog" in line["detail"]["error"]
+    assert line["value"] == 0.0
+
+
+def test_watchdog_emits_held_result_instead_of_error():
+    # A wedge AFTER the strategy race concluded must publish the verified
+    # encode number (exit 0), not a value-0 error line.
+    code = (
+        "import importlib.util, time\n"
+        f"spec = importlib.util.spec_from_file_location('b', {os.path.join(REPO, 'bench.py')!r})\n"
+        "m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)\n"
+        "m._PARTIAL = ('tpu', ('pallas', 64.3), {'pallas': 64.3})\n"
+        "m._arm_wedge_watchdog()\n"
+        "time.sleep(10)\n"
+    )
+    env = dict(os.environ, RS_BENCH_WATCHDOG_S="1", PYTHONPATH="")
+    run = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=30,
+    )
+    assert run.returncode == 0
+    line = json.loads(run.stdout.strip().splitlines()[0])
+    assert line["metric"].endswith("_tpu")
+    assert line["value"] == 64.3
+    assert "watchdog" in line["detail"]
+
+
+def test_watchdog_armed_even_in_hardware_only_mode():
+    # RS_BENCH_NO_FALLBACK means "no CPU fallback", not "no wedge guard" —
+    # a hardware-only run is the MOST exposed to a tunnel wedge.
+    m = _load_bench()
+    os.environ["RS_BENCH_NO_FALLBACK"] = "1"
+    os.environ["RS_BENCH_WATCHDOG_S"] = "3600"
+    try:
+        m._arm_wedge_watchdog()
+        assert m._WATCHDOG is not None
+        m._WATCHDOG.cancel()
+    finally:
+        del os.environ["RS_BENCH_NO_FALLBACK"]
+        del os.environ["RS_BENCH_WATCHDOG_S"]
