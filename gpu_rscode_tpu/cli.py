@@ -197,8 +197,13 @@ def main(argv: list[str] | None = None) -> int:
     if stripe > 1 and not n_devices:
         return _fail("rs: --stripe requires --devices")
     if n_devices:
+        from .parallel import distributed
         from .parallel.mesh import make_mesh
 
+        # Env-driven no-op single-process; under JAX_COORDINATOR_ADDRESS /
+        # JAX_NUM_PROCESSES / JAX_PROCESS_ID it joins the multi-host job so
+        # --devices can span processes (the file ops become collectives).
+        distributed.initialize()
         kwargs["mesh"] = make_mesh(n_devices, stripe=stripe)
         kwargs["stripe_sharded"] = stripe > 1
     if segment_bytes:

@@ -35,6 +35,9 @@ def initialize(
     """
     import jax
 
+    if jax.distributed.is_initialized():
+        return  # idempotent: callers (library AND cli) may both invoke this
+
     coordinator_address = coordinator_address or os.environ.get(
         "JAX_COORDINATOR_ADDRESS"
     )

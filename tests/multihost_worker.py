@@ -198,6 +198,21 @@ def main() -> None:
             assert a == b, f"w16 repaired chunk {i} differs from golden"
     multihost_utils.sync_global_devices("w16_repair_checked")
 
+    # --- CLI over the process-spanning mesh: --devices 8 joins the already
+    # -initialized distributed job (idempotent initialize) and the decode
+    # runs as the same collective the api-level test proved ----------------
+    from gpu_rscode_tpu import cli
+
+    out_cli = os.path.join(workdir, "recovered_cli.bin")
+    rc = cli.main([
+        "-d", "-i", wpath, "-c", conf16, "-o", out_cli,
+        "--devices", "8", "--quiet",
+    ])
+    assert not rc, f"cli multi-host decode rc={rc}"
+    if pid == 0:
+        assert open(out_cli, "rb").read() == payload, "cli mp decode differs"
+    multihost_utils.sync_global_devices("cli_checked")
+
     # --- all-natives mp decode: no missing rows, so no GEMM runs at all —
     # just the round-robin passthrough copies across hosts -----------------
     conf_nat = os.path.join(workdir, "natives.conf")
