@@ -119,11 +119,18 @@ def _arm_wedge_watchdog() -> None:
                     },
                 )
             except Exception:
-                # Never die silently in the watchdog thread — a minimal
-                # line beats the no-output failure mode this guards.
-                emitted = _emit(
-                    backend, best[1], {"strategy": best[0], "watchdog": "fired"}
-                )
+                # Never die silently in the watchdog thread.  If the first
+                # _emit latched the gate and THEN failed mid-print (broken
+                # stdout), the fallback can't print either — exit anyway:
+                # a lingering wedged process with no line is the one
+                # outcome this thread exists to prevent.
+                try:
+                    _emit(
+                        backend, best[1],
+                        {"strategy": best[0], "watchdog": "fired"},
+                    )
+                finally:
+                    os._exit(0)
             if emitted:
                 _mark("watchdog fired; emitted the held result")
                 os._exit(0)

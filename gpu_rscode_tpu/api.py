@@ -1094,16 +1094,33 @@ def auto_decode_file(
     needing end-to-end integrity on live-mutating storage should pass
     ``verify_checksums=True`` explicitly to re-check at read time.
     """
-    scan = _scan_chunks(
-        in_file, decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
-    )
-    chosen, _ = _select_decodable_subset(scan)
-
     conf_path = conf_out or (in_file + ".auto.conf")
-    write_conf(
-        conf_path,
-        [os.path.basename(chunk_file_name(in_file, i)) for i in chosen],
-    )
+    procs = _mesh_processes(decode_kwargs.get("mesh"))
+    # With a process-spanning mesh this is a collective: only the LEAD
+    # scans (one CRC read of the archive, not one per host) and writes the
+    # conf to the shared filesystem; peers wait at the barrier.  A
+    # lead-side scan failure leaves the peers blocked until the jax
+    # coordinator tears the job down — the same failure contract as the
+    # other file collectives.
+    if len(procs) > 1:
+        import jax
+
+        lead = jax.process_index() == procs[0]
+    else:
+        lead = True
+    if lead:
+        scan = _scan_chunks(
+            in_file, decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
+        )
+        chosen, _ = _select_decodable_subset(scan)
+        write_conf(
+            conf_path,
+            [os.path.basename(chunk_file_name(in_file, i)) for i in chosen],
+        )
+    if len(procs) > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("rs_auto_conf_written")
     # The scan above already CRC-verified exactly the chunks it selected —
     # don't pay a second full read in decode_file unless the caller
     # explicitly demanded verification.

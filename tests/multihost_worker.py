@@ -249,6 +249,17 @@ def main() -> None:
     assert not os.path.exists(os.path.join(workdir, "never.bin.rs_tmp"))
     multihost_utils.sync_global_devices("corrupt_checked")
 
+    # --- collective auto-decode: the lead scans (dropping the chunk the
+    # previous step corrupted via its CRC), writes the conf, and the mp
+    # decode recovers the file from the remaining survivors ----------------
+    out_auto = os.path.join(workdir, "recovered_auto.bin")
+    api.auto_decode_file(path, out_auto, mesh=mesh, segment_bytes=128 * 1024)
+    if pid == 0:
+        assert open(out_auto, "rb").read() == payload, "mp auto-decode differs"
+        auto_conf = open(path + ".auto.conf").read()
+        assert "_2_" not in auto_conf, f"corrupt chunk kept: {auto_conf}"
+    multihost_utils.sync_global_devices("auto_checked")
+
     print("MULTIHOST_OK", flush=True)
 
 
