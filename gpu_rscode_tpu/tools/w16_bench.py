@@ -1,0 +1,74 @@
+"""Wide-symbol (GF(2^16)) device GEMM throughput — run on real TPU.
+
+The reference's GF(16) "extend" branch is its fastest kernel
+(design.tex:490: 2067.514 MB/s encode vs 1356.835 GF(256)); this measures
+the analogous wide-symbol path here (w=16 bit-plane operators, 16 planes in
+int16-range lanes) so the wide-format extension has a hardware number next
+to the GF(2^8) headline.
+
+Usage: python -m gpu_rscode_tpu.tools.w16_bench [--mb 320] [--trials 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gpu_rscode_tpu.tools.w16_bench"
+    )
+    ap.add_argument("--mb", type=int, default=320, help="total data MB")
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+
+    import jax
+
+    from ..models.vandermonde import vandermonde_matrix
+    from ..ops.gf import get_field
+    from ..ops.gemm import gf_matmul_jit
+    from ..ops.pallas_gemm import gf_matmul_pallas
+    from ._bench_timing import time_device_fn as _time
+
+    K, P, W = 10, 4, 16
+    m_sym = args.mb * 1024 * 1024 // (K * 2)
+    m_sym = (m_sym // 512) * 512
+    seg_sym = 2 * 1024 * 1024  # bitplane slice (bounds its 16x HBM expansion)
+
+    gf = get_field(W)
+    A = vandermonde_matrix(P, K, gf)
+    rng = np.random.default_rng(0)
+    B = rng.integers(0, 1 << 16, size=(K, m_sym), dtype=np.uint16)
+    Ad, Bd = jax.device_put(A), jax.device_put(B)
+    oracle = gf.matmul(A, B[:, :2048])
+
+    out: dict = {}
+    Bseg = jax.device_put(B[:, :seg_sym])  # sliced once, outside the timing
+    cases = (
+        ("pallas", lambda: gf_matmul_pallas(Ad, Bd, w=W), K * m_sym * 2),
+        (
+            "bitplane",
+            lambda: gf_matmul_jit(Ad, Bseg, w=W, strategy="bitplane"),
+            K * min(seg_sym, m_sym) * 2,
+        ),
+    )
+    for name, fn, data_bytes in cases:
+        try:
+            got = np.asarray(fn()[:, :2048])
+            if not np.array_equal(got, oracle):
+                out[name] = "MISMATCH"
+            else:
+                dt = _time(fn, trials=args.trials)
+                out[name] = round(data_bytes / dt / 1e9, 2)
+        except Exception as e:  # noqa: BLE001 — record, keep sweeping
+            out[name] = f"fail:{type(e).__name__}"
+        print(json.dumps({name: out[name]}), flush=True)
+    print(json.dumps({"w": W, "mb": args.mb, "results": out}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
