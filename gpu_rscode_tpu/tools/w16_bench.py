@@ -66,7 +66,17 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 — record, keep sweeping
             out[name] = f"fail:{type(e).__name__}"
         print(json.dumps({name: out[name]}), flush=True)
-    print(json.dumps({"w": W, "mb": args.mb, "results": out}), flush=True)
+    print(
+        json.dumps(
+            {
+                "metric": f"w16_gemm_bandwidth_k{K}_p{P}",
+                "unit": "GB/s",
+                "mb": args.mb,
+                "results": out,
+            }
+        ),
+        flush=True,
+    )
     return 0
 
 

@@ -127,3 +127,45 @@ def test_unlink_shared_tmps_tolerates_losing_the_race(tmp_path):
     _unlink_shared_tmps([str(present), str(missing)])
     assert not present.exists()
     assert not os.path.exists(str(missing))
+
+
+def test_padded_stage_randomized_span_arithmetic(tmp_path):
+    # Randomized shapes: whatever the (chunk, off, cols, sym) combination,
+    # the staged block must equal the file bytes at the right offsets with
+    # zeros past the chunk end — the arithmetic decode/repair rely on.
+    rng = np.random.default_rng(42)
+    mesh = make_mesh(8)
+    sharding = _cols_sharding(mesh)
+    for trial in range(12):
+        sym = int(rng.choice([1, 2]))
+        k = int(rng.integers(2, 6))
+        # sym-aligned but NOT 128-aligned: the final segment is ragged, so
+        # the padded width W > cols and the zero-fill path actually runs.
+        chunk = int(rng.integers(200, 5000)) * sym
+        rows = rng.integers(0, 256, size=(k, chunk), dtype=np.uint8)
+        paths = []
+        for i in range(k):
+            p = tmp_path / f"t{trial}_c{i}"
+            p.write_bytes(rows[i].tobytes())
+            paths.append(str(p))
+        fps = [open(p, "rb") for p in paths]
+        maps = [np.memmap(p, dtype=np.uint8, mode="r") for p in paths]
+        try:
+            stage = _make_padded_stage(
+                fps, maps, chunk, mesh.shape[COLS], sharding, k,
+                PhaseTimer(False), sym,
+            )
+            seg_cols = int(rng.integers(1, 8)) * 128 * sym
+            off = 0
+            while off < chunk:
+                cols = min(seg_cols, chunk - off)
+                seg = stage(off, cols)
+                got = seg if sym == 1 else np.ascontiguousarray(seg).view(np.uint8)
+                assert np.array_equal(
+                    got[:, :cols], rows[:, off : off + cols]
+                ), (trial, off, cols)
+                assert not got[:, cols:].any(), (trial, off, cols)
+                off += cols
+        finally:
+            for fp in fps:
+                fp.close()
