@@ -88,12 +88,22 @@ def read_metadata_ext(path: str):
     with open(path) as fp:
         text = fp.read()
     total_size, parity_num, native_num, mat = _parse_metadata(text, path)
+    w = _parse_field_width(text)
+    # Width-aware chunk cap (the parse-time cap only enforces the widest
+    # field's 65536): a w=8 header declaring n > 256 would regenerate a
+    # Vandermonde with repeated evaluation points — singular submatrices
+    # and wrong recoveries, not a clear error.
+    if native_num + parity_num > (1 << w):
+        raise ValueError(
+            f"metadata declares n={native_num + parity_num} chunks in "
+            f"{path!r} but GF(2^{w}) supports at most {1 << w}"
+        )
     return (
         total_size,
         parity_num,
         native_num,
         mat,
-        _parse_field_width(text),
+        w,
         _parse_checksums(text),
     )
 
@@ -119,6 +129,18 @@ def _parse_metadata(text: str, path: str):
     if len(tokens) < 3:
         raise ValueError(f"malformed metadata file {path!r}")
     total_size, parity_num, native_num = int(tokens[0]), int(tokens[1]), int(tokens[2])
+    # A corrupt or hostile header must fail HERE with a clear message, not
+    # as a ZeroDivisionError in chunk sizing or a bogus reshape later.
+    if total_size <= 0 or parity_num <= 0 or native_num <= 0:
+        raise ValueError(
+            f"metadata fields out of range in {path!r}: size={total_size} "
+            f"p={parity_num} k={native_num} (all must be positive)"
+        )
+    if native_num + parity_num > 65536:
+        raise ValueError(
+            f"metadata declares n={native_num + parity_num} chunks in "
+            f"{path!r}; the widest supported field (GF(2^16)) caps n at 65536"
+        )
     want = (native_num + parity_num) * native_num
     if len(tokens) == 3:
         # The reference's CPU-RS dialect: sizes only, no matrix — decode
@@ -131,6 +153,11 @@ def _parse_metadata(text: str, path: str):
             f"metadata matrix truncated: expected {want} entries, got {len(mat_tokens)}"
         )
     vals = [int(t) for t in mat_tokens]
+    if min(vals) < 0 or max(vals) > 65535:
+        raise ValueError(
+            f"metadata matrix entry out of range in {path!r}: "
+            f"[{min(vals)}, {max(vals)}] outside [0, 65535]"
+        )
     # uint16 when any entry exceeds a byte (GF(2^16) extension metadata);
     # the reference's GF(2^8) files always fit uint8.
     dtype = np.uint16 if max(vals) > 255 else np.uint8

@@ -11,6 +11,7 @@ from gpu_rscode_tpu.utils.fileformat import (
     parse_chunk_index,
     read_conf,
     read_metadata,
+    read_metadata_ext,
     write_conf,
     write_metadata,
 )
@@ -74,3 +75,44 @@ def test_conf_roundtrip(tmp_path):
 
 def test_metadata_name():
     assert metadata_file_name("dir/f.bin") == "dir/f.bin.METADATA"
+
+
+@pytest.mark.parametrize(
+    "header",
+    [
+        "1024 0 4",     # zero parity
+        "1024 2 0",     # zero natives -> would divide by zero in sizing
+        "-5 2 4",       # negative size
+        "1024 -1 4",    # negative parity
+        "1024 40000 40000",  # n > 65536, GF(2^16) cap
+    ],
+)
+def test_metadata_hostile_headers_rejected(tmp_path, header):
+    path = tmp_path / "f.METADATA"
+    path.write_text(header + "\n")
+    with pytest.raises(ValueError):
+        read_metadata_ext(str(path))
+
+
+def test_metadata_out_of_range_matrix_entry_rejected(tmp_path):
+    # 6x2 matrix with one negative and one >65535 entry: both must refuse
+    # instead of wrapping silently into uint8/uint16.
+    for bad in ("-3", "70000"):
+        entries = ["1"] * 11 + [bad]
+        path = tmp_path / "g.METADATA"
+        path.write_text("1024 4 2 " + " ".join(entries) + "\n")
+        with pytest.raises(ValueError, match="out of range"):
+            read_metadata_ext(str(path))
+
+
+def test_metadata_chunk_cap_is_width_aware(tmp_path):
+    # Sizes-only CPU-RS dialect, w=8 implied: n=302 > 256 must refuse
+    # (a regenerated GF(2^8) Vandermonde would repeat evaluation points).
+    path = tmp_path / "h.METADATA"
+    path.write_text("1024 300 2\n")
+    with pytest.raises(ValueError, match="at most 256"):
+        read_metadata_ext(str(path))
+    # The same n under gfwidth 16 is fine.
+    path.write_text("1024 300 2\n# gfwidth 16\n")
+    total_size, p, k, mat, w, crcs = read_metadata_ext(str(path))
+    assert (p, k, w) == (300, 2, 16)
