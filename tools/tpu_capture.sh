@@ -35,7 +35,10 @@ EOF
       > "bench_captures/bench_${ts}.json" 2> "bench_captures/bench_${ts}.log"
     brc=$?
     if [ $brc -eq 0 ] && grep -q '_tpu"' "bench_captures/bench_${ts}.json"; then
-      echo "# bench capture OK: bench_captures/bench_${ts}.json" >&2
+      # The bench_tpu_ prefix is what bench.py's committed-capture pointer
+      # globs for (bench.py _committed_tpu_captures) — keep them findable.
+      mv "bench_captures/bench_${ts}.json" "bench_captures/bench_tpu_${ts}.json"
+      echo "# bench capture OK: bench_captures/bench_tpu_${ts}.json" >&2
       if [ "$MODE" = "--bench-only" ]; then exit 0; fi
       timeout 1800 python -m gpu_rscode_tpu.tools.kernel_sweep --mb 64 --trials 2 \
         > "bench_captures/sweep_${ts}.json" 2> "bench_captures/sweep_${ts}.log"
