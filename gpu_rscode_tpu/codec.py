@@ -48,6 +48,12 @@ def _pallas_failure_types() -> tuple:
     return tuple(types)
 
 
+# One shared definition of "on real TPU hardware" (device platform first —
+# a tunnel backend may serve TPU chips under its own registration name;
+# see utils/backend.py).  Module-level alias kept for tests/monkeypatching.
+from .utils.backend import tpu_devices_present as _tpu_devices_present
+
+
 class RSCodec:
     """(n, k) Reed-Solomon codec over GF(2^w).
 
@@ -78,7 +84,7 @@ class RSCodec:
             # leave partial output files).  Explicit strategy="pallas" works
             # on meshes — both sharding modes (the stripe mode via the
             # kernel's pre-parity output) — for callers who accept that.
-            if mesh is not None or jax.default_backend() != "tpu":
+            if mesh is not None or not _tpu_devices_present():
                 strategy = "bitplane"
             else:
                 strategy = "pallas"

@@ -226,7 +226,11 @@ def gf_matmul_pallas(
     A = jnp.asarray(A)
     B = jnp.asarray(B)
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        # Device-platform check, not backend name: a tunnel backend serving
+        # real TPU chips must compile, not interpret (utils/backend.py).
+        from ..utils.backend import tpu_devices_present
+
+        interpret = not tpu_devices_present()
     if tile is None:
         tile = DEFAULT_TILE if interpret else TPU_TILE
     if acc_dtype is None:

@@ -192,9 +192,9 @@ def main():
 
     # The tunnel backend may self-report as "axon" while its devices are real
     # TPU chips — gate on the device platform, not the registration name.
-    assert any(
-        d.platform.lower() == "tpu" for d in jax.devices()
-    ) or jax.default_backend() == "tpu", "sweep is for real hardware"
+    from ..utils.backend import tpu_devices_present
+
+    assert tpu_devices_present(), "sweep is for real hardware"
     m = args.mb * 1024 * 1024 // K
     m = (m // 512) * 512
     A = vandermonde_matrix(P, K)

@@ -1,0 +1,23 @@
+"""Backend identity helpers shared by strategy selection and kernels.
+
+One definition of "are we on real TPU hardware": by DEVICE PLATFORM first,
+backend name second.  A tunnel plugin (axon) may register under its own
+backend name while serving genuine TPU chips; any code that gates on
+``jax.default_backend() == "tpu"`` alone silently misroutes such hardware
+(interpret-mode kernels, bitplane fallbacks).  Keep every TPU check on this
+helper so the next tunnel quirk is fixed in exactly one place.
+"""
+
+from __future__ import annotations
+
+
+def tpu_devices_present() -> bool:
+    """True when the default backend's devices are real TPU chips."""
+    import jax
+
+    if jax.default_backend() == "tpu":
+        return True
+    try:
+        return any(d.platform.lower() == "tpu" for d in jax.devices())
+    except Exception:  # uninitialisable backend: treat as no TPU
+        return False

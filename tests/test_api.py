@@ -706,3 +706,24 @@ def test_mesh_auto_decode_roundtrip(tmp_path):
     assert open(out, "rb").read() == orig
     chosen = open(path + ".auto.conf").read()
     assert "_1_" not in chosen and "_3_" not in chosen
+
+
+def test_auto_strategy_detects_tpu_by_device_platform(monkeypatch):
+    """A tunnel backend (e.g. axon) self-reports its own backend name while
+    serving real TPU chips; strategy='auto' must resolve by DEVICE platform
+    so such hardware gets the fused kernel, not the bitplane fallback."""
+    import gpu_rscode_tpu.codec as codec_mod
+
+    class _FakeDev:
+        platform = "TPU"
+
+    monkeypatch.setattr(codec_mod.jax, "default_backend", lambda: "axon")
+    monkeypatch.setattr(codec_mod.jax, "devices", lambda: [_FakeDev()])
+    assert codec_mod._tpu_devices_present() is True
+    c = codec_mod.RSCodec(4, 2, strategy="auto")
+    assert c.strategy == "pallas"
+
+    # And a genuinely non-TPU backend still resolves to bitplane.
+    monkeypatch.setattr(codec_mod.jax, "devices", lambda: [])
+    assert codec_mod._tpu_devices_present() is False
+    assert codec_mod.RSCodec(4, 2, strategy="auto").strategy == "bitplane"
