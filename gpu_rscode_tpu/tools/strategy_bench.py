@@ -52,6 +52,8 @@ def main(argv=None) -> int:
 
     import jax
 
+    from ..utils.backend import backend_label
+
     from .. import native
     from ..models.vandermonde import vandermonde_matrix
     from ..ops.gemm import gf_matmul_jit
@@ -90,7 +92,9 @@ def main(argv=None) -> int:
     print(
         json.dumps(
             {
-                "metric": f"strategy_bench_k{k}_p{p}_{jax.default_backend()}",
+                # Label by device platform (tunnel backends serve real TPU
+                # chips under their own registration name).
+                "metric": f"strategy_bench_k{k}_p{p}_{backend_label()}",
                 "unit": "GB/s",
                 "results": results,
             }

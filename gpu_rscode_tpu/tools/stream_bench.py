@@ -28,6 +28,7 @@ import numpy as np
 
 from ..api import decode_file, encode_file
 from ..utils.fileformat import chunk_file_name, write_conf
+from ..utils.backend import backend_label
 from ..utils.timing import PhaseTimer
 
 
@@ -96,7 +97,7 @@ def main(argv=None) -> int:
 
         ok = _digest(out) == digest_src
         result = {
-            "metric": f"stream_file_k{k}_n{k + p}_{jax.default_backend()}",
+            "metric": f"stream_file_k{k}_n{k + p}_{backend_label()}",
             "unit": "GB/s",
             "file_mb": args.mb,
             "depth": args.depth,

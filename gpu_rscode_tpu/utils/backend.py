@@ -21,3 +21,12 @@ def tpu_devices_present() -> bool:
         return any(d.platform.lower() == "tpu" for d in jax.devices())
     except Exception:  # uninitialisable backend: treat as no TPU
         return False
+
+
+def backend_label() -> str:
+    """Metric/artifact label for the current backend: "tpu" whenever the
+    devices are real TPU chips (whatever name the plugin registered),
+    else the backend's own name."""
+    import jax
+
+    return "tpu" if tpu_devices_present() else jax.default_backend()
