@@ -395,6 +395,27 @@ def main() -> None:
         "table": lambda: gf_matmul_jit(Ad, Bd_small, strategy="table"),
     }
     candidates = [("pallas", run_pallas), ("bitplane", run_bitplane), ("table", run_table)]
+    if not on_tpu and native.available():
+        # The threaded C++ host codec (strategy="cpu") is the strongest
+        # non-device path (~2.3x the XLA table strategy on this host) — a
+        # tunnel-outage fallback line should reflect the framework's best
+        # CPU capability, not just its device strategies.  Verified against
+        # the independent pure-NumPy bitwise oracle (native.gemm itself is
+        # the usual oracle, so it cannot self-verify — and gated on the
+        # real C++ library being loaded, since native.gemm's NumPy fallback
+        # IS that oracle).
+        from gpu_rscode_tpu.ops.gf import get_field
+
+        numpy_oracle = get_field(8).matmul(A, B_host[:, :4096])
+
+        def run_native():
+            return native.gemm(A, B_host)
+
+        small["native"] = lambda: native.gemm(A, B_host[:, :4096])
+        candidates.append(("native", run_native))
+        sample_by_name = {"native": numpy_oracle}
+    else:
+        sample_by_name = {}
     data_bytes = K * m
     detail = {}
     best = (None, 0.0)
@@ -402,7 +423,7 @@ def main() -> None:
     for name, fn in candidates:
         try:
             _mark(f"verify {name}")
-            _verify(small[name], sample)
+            _verify(small[name], sample_by_name.get(name, sample))
             _mark(f"time {name}")
             dt = _time(fn)
             gbps = data_bytes / dt / 1e9
@@ -434,12 +455,17 @@ def main() -> None:
     T = total_matrix(P, K)
     surv = list(range(P, P + K))
     inv_missing = invert_matrix(T[surv])[:P]  # only the lost rows
-    survivors = jax.device_put(
-        np.concatenate([B_host[P:], native.gemm(T[K:], B_host)], axis=0)[: K]
-    )
+    survivors_host = np.concatenate(
+        [B_host[P:], native.gemm(T[K:], B_host)], axis=0
+    )[:K]
+    if best[0] != "native":  # the native path never touches the device
+        survivors = jax.device_put(survivors_host)
     if best[0] == "pallas":
         def run_decode():
             return gf_matmul_pallas(jax.device_put(inv_missing), survivors)
+    elif best[0] == "native":
+        def run_decode():
+            return native.gemm(inv_missing, survivors_host)
     else:
         def run_decode():
             outs = [

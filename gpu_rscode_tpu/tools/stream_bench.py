@@ -45,7 +45,6 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default=None, help="work dir (default: tmpdir)")
     args = ap.parse_args(argv)
 
-    import jax
 
     k, p = args.k, args.p
     size = args.mb * 1024 * 1024
