@@ -727,3 +727,24 @@ def test_auto_strategy_detects_tpu_by_device_platform(monkeypatch):
     monkeypatch.setattr(codec_mod.jax, "devices", lambda: [])
     assert codec_mod._tpu_devices_present() is False
     assert codec_mod.RSCodec(4, 2, strategy="auto").strategy == "bitplane"
+
+
+def test_backend_label_prefers_device_platform(monkeypatch):
+    from gpu_rscode_tpu.utils import backend as b
+
+    class _FakeDev:
+        platform = "tpu"
+
+    import jax
+
+    monkeypatch.setattr(jax, "default_backend", lambda: "axon")
+    monkeypatch.setattr(jax, "devices", lambda: [_FakeDev()])
+    assert b.backend_label() == "tpu"
+    monkeypatch.setattr(jax, "devices", lambda: [])
+    assert b.backend_label() == "axon"
+
+    def _boom():
+        raise RuntimeError("uninitialisable")
+
+    monkeypatch.setattr(jax, "devices", _boom)
+    assert b.tpu_devices_present() is False  # failure -> portable path
