@@ -110,6 +110,69 @@ def _mesh_processes(mesh) -> list[int]:
     return sorted({d.process_index for d in mesh.devices.flat})
 
 
+def _open_chunk(path: str, chunk: int) -> np.ndarray:
+    """Read-only byte view of a chunk file, validated against the expected
+    size.  Zero-size archives (chunk == 0, foreign reference encodes of an
+    empty file) get an empty array — np.memmap refuses zero-byte files."""
+    mm = (
+        np.zeros(0, dtype=np.uint8)
+        if chunk == 0
+        else np.memmap(path, dtype=np.uint8, mode="r")
+    )
+    if mm.shape[0] < chunk:
+        raise ValueError(
+            f"chunk {path!r} is {mm.shape[0]} bytes, expected {chunk}"
+        )
+    return mm
+
+
+def _write_empty_atomic(out_path: str) -> str:
+    """Atomically produce a zero-byte output file (the decode result of a
+    totalSize=0 archive) under the same .rs_tmp commit protocol."""
+    tmp_path = out_path + ".rs_tmp"
+    with open(tmp_path, "wb"):
+        pass
+    os.replace(tmp_path, out_path)
+    return out_path
+
+
+def _broadcast_lead_verdict(scan_err, procs, what: str) -> None:
+    """Lockstep lead-error propagation for collectives whose lead does
+    work peers cannot see (archive scan, survivor selection, conf write).
+
+    Broadcasts an ok/error flag from the lead; on error every process
+    raises — the lead its original exception, peers a RuntimeError naming
+    the lead — instead of the peers wedging at the next barrier until
+    coordinator teardown.  Call on ALL processes, before the barrier that
+    consumes the lead's work.  (Collectives that already broadcast lead
+    state piggyback a sentinel on that array instead — e.g. the -1 health
+    state in _repair_file_multiprocess, the CRC bad_mask in
+    _decode_file_multiprocess — saving the extra collective.)
+    """
+    from jax.experimental import multihost_utils
+
+    flag = np.array([1 if scan_err is not None else 0], dtype=np.int32)
+    flag = np.asarray(
+        multihost_utils.broadcast_one_to_all(flag, is_source=_is_lead(procs))
+    )
+    if flag[0]:
+        if scan_err is not None:
+            raise scan_err
+        raise RuntimeError(
+            f"{what} failed on the lead process (process {procs[0]}); "
+            "see its log for the cause"
+        )
+
+
+def _is_lead(procs) -> bool:
+    """Whether this process is the collective's lead (True single-process)."""
+    if len(procs) <= 1:
+        return True
+    import jax
+
+    return jax.process_index() == procs[0]
+
+
 def _write_native_chunks(
     src: np.ndarray,
     file_name: str,
@@ -541,11 +604,7 @@ def decode_file(
         paths = []
         for nm in names:
             path = resolve(nm)
-            mm = np.memmap(path, dtype=np.uint8, mode="r")
-            if mm.shape[0] < chunk:
-                raise ValueError(
-                    f"chunk {path!r} is {mm.shape[0]} bytes, expected {chunk}"
-                )
+            mm = _open_chunk(path, chunk)
             maps.append(mm)
             paths.append(path)
 
@@ -575,6 +634,15 @@ def decode_file(
                         bad[row] = path
                 if bad:
                     raise ChunkIntegrityError(bad)
+
+    if total_size == 0:
+        # Foreign zero-byte archive (the reference encoder sizes by ftell
+        # with no empty-file guard, cpu-rs.c:492-495, so an empty input
+        # yields totalSize=0 metadata): every chunk is zero bytes and the
+        # original is the empty file.  Placed AFTER chunk resolution and
+        # the checksum contract checks — a conf naming absent chunks or
+        # verify_checksums=True without CRC lines still fails loudly.
+        return _write_empty_atomic(output or in_file)
 
     codec = RSCodec(
         k, p, w=w, strategy=strategy, mesh=mesh, stripe_sharded=stripe_sharded
@@ -778,7 +846,7 @@ def _decode_file_multiprocess(
     from .parallel.sharded import put_sharded, sharded_gf_matmul
 
     procs = _mesh_processes(mesh)
-    lead = jax.process_index() == procs[0]
+    lead = _is_lead(procs)
 
     with timer.phase("read metadata (io)"):
         total_size, p, k, total_mat, w, crcs = read_metadata_ext(
@@ -813,11 +881,7 @@ def _decode_file_multiprocess(
         maps, paths = [], []
         for nm in names:
             path = resolve(nm)
-            mm = np.memmap(path, dtype=np.uint8, mode="r")
-            if mm.shape[0] < chunk:
-                raise ValueError(
-                    f"chunk {path!r} is {mm.shape[0]} bytes, expected {chunk}"
-                )
+            mm = _open_chunk(path, chunk)
             maps.append(mm)
             paths.append(path)
 
@@ -854,6 +918,16 @@ def _decode_file_multiprocess(
                         rows[pos]: paths[pos]
                         for pos in np.flatnonzero(bad_mask)
                     })
+
+    if total_size == 0:
+        # Foreign zero-byte archive (see decode_file — same placement,
+        # after chunk resolution and the checksum contract checks): the
+        # lead writes the empty output; all processes leave in lockstep.
+        out_path = output or in_file
+        if lead:
+            _write_empty_atomic(out_path)
+        multihost_utils.sync_global_devices("rs_decode_promoted")
+        return out_path
 
     codec = RSCodec(k, p, w=w, strategy=strategy, mesh=mesh)
     total_mat = total_mat.astype(codec.gf.dtype)
@@ -1005,7 +1079,7 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
             bad[i] = path  # present but truncated — damage, not loss
             continue
         if i in crcs:
-            mm = np.memmap(path, dtype=np.uint8, mode="r")
+            mm = _open_chunk(path, chunk)  # empty-safe for chunk == 0
             if chunk_crc32(mm, chunk, segment_bytes) != crcs[i]:
                 bad[i] = path
                 continue
@@ -1098,28 +1172,34 @@ def auto_decode_file(
     procs = _mesh_processes(decode_kwargs.get("mesh"))
     # With a process-spanning mesh this is a collective: only the LEAD
     # scans (one CRC read of the archive, not one per host) and writes the
-    # conf to the shared filesystem; peers wait at the barrier.  A
-    # lead-side scan failure leaves the peers blocked until the jax
-    # coordinator tears the job down — the same failure contract as the
-    # other file collectives.
-    if len(procs) > 1:
-        import jax
-
-        lead = jax.process_index() == procs[0]
-    else:
-        lead = True
-    if lead:
-        scan = _scan_chunks(
-            in_file, decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
-        )
-        chosen, _ = _select_decodable_subset(scan)
-        write_conf(
-            conf_path,
-            [os.path.basename(chunk_file_name(in_file, i)) for i in chosen],
-        )
+    # conf to the shared filesystem; peers wait at the barrier.  The
+    # scan verdict — ok or error — is broadcast before that barrier so a
+    # lead-side failure (corrupt metadata, unrecoverable archive) raises
+    # on every process instead of wedging the peers until coordinator
+    # teardown.
+    scan_err: Exception | None = None
+    if _is_lead(procs):
+        try:
+            scan = _scan_chunks(
+                in_file,
+                decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES),
+            )
+            chosen, _ = _select_decodable_subset(scan)
+            write_conf(
+                conf_path,
+                [os.path.basename(chunk_file_name(in_file, i))
+                 for i in chosen],
+            )
+        except Exception as e:
+            if len(procs) <= 1:
+                raise  # no peers to unblock — fail directly
+            scan_err = e
     if len(procs) > 1:
         from jax.experimental import multihost_utils
 
+        _broadcast_lead_verdict(
+            scan_err, procs, "archive scan / survivor selection"
+        )
         multihost_utils.sync_global_devices("rs_auto_conf_written")
     # The scan above already CRC-verified exactly the chunks it selected —
     # don't pay a second full read in decode_file unless the caller
@@ -1177,6 +1257,21 @@ def repair_file(
     targets = scan.unhealthy
     if not targets:
         return []
+    if scan.chunk == 0:
+        # Zero-size foreign archive: every chunk is the empty file, so
+        # "rebuild" is recreating empties — no survivors read, no GEMM.
+        # Still subject to the >=k-healthy contract (raises otherwise) so
+        # repairability matches scan_file's decodable verdict: an archive
+        # that cannot produce a valid k-chunk conf is not "repairable".
+        _select_decodable_subset(scan)
+        for t in targets:
+            _write_empty_atomic(chunk_file_name(in_file, t))
+        if scan.crcs:
+            rewrite_checksums(
+                metadata_file_name(in_file),
+                {**scan.crcs, **{t: 0 for t in targets}},  # crc32(b"") == 0
+            )
+        return targets
     with timer.phase("invert matrix"):
         chosen, inv = _select_decodable_subset(scan)
         gf = get_field(scan.w)
@@ -1285,7 +1380,7 @@ def _repair_file_multiprocess(
     from .parallel.sharded import put_sharded, sharded_gf_matmul
 
     procs = _mesh_processes(mesh)
-    lead = jax.process_index() == procs[0]
+    lead = _is_lead(procs)
 
     # Health state: lead scans (CRC IO once, not once per host), peers get
     # the verdict as a (k+p,) array: 0 = missing, 1 = healthy, 2 = damaged.
@@ -1297,13 +1392,29 @@ def _repair_file_multiprocess(
         if total_mat is None:
             total_mat = _regenerate_total_matrix(p, k, w)
         state = np.zeros(k + p, dtype=np.int32)
+        scan_err: Exception | None = None
         if lead:
-            scan = _scan_chunks(in_file, segment_bytes)
-            state[scan.healthy] = 1
-            state[sorted(scan.bad)] = 2
+            # A lead-side scan failure must reach the peers as an error,
+            # not leave them wedged at the broadcast: sentinel the whole
+            # state array (-1 is outside the 0/1/2 health encoding), then
+            # raise in lockstep after the collective.
+            try:
+                scan = _scan_chunks(in_file, segment_bytes)
+                state[scan.healthy] = 1
+                state[sorted(scan.bad)] = 2
+            except Exception as e:
+                scan_err = e
+                state[:] = -1
         state = np.asarray(
             multihost_utils.broadcast_one_to_all(state, is_source=lead)
         )
+        if (state < 0).any():
+            if scan_err is not None:
+                raise scan_err
+            raise RuntimeError(
+                "chunk scan failed on the lead process "
+                f"(process {procs[0]}); see its log for the cause"
+            )
     healthy = [int(i) for i in np.flatnonzero(state == 1)]
     bad = {
         int(i): chunk_file_name(in_file, int(i))
@@ -1316,6 +1427,21 @@ def _repair_file_multiprocess(
     targets = scan_view.unhealthy
     if not targets:
         return []
+    if chunk == 0:
+        # Zero-size foreign archive (see repair_file): the lead recreates
+        # the empty chunks; all processes leave in lockstep.  Same
+        # >=k-healthy contract as the general path (raises everywhere —
+        # all processes share the broadcast health state).
+        _select_decodable_subset(scan_view)
+        if lead:
+            for t in targets:
+                _write_empty_atomic(chunk_file_name(in_file, t))
+            if crcs:
+                rewrite_checksums(
+                    meta, {**crcs, **{t: 0 for t in targets}}
+                )
+        multihost_utils.sync_global_devices("rs_repair_promoted")
+        return targets
 
     with timer.phase("invert matrix"):
         chosen, inv = _select_decodable_subset(scan_view)

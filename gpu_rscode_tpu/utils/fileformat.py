@@ -131,10 +131,14 @@ def _parse_metadata(text: str, path: str):
     total_size, parity_num, native_num = int(tokens[0]), int(tokens[1]), int(tokens[2])
     # A corrupt or hostile header must fail HERE with a clear message, not
     # as a ZeroDivisionError in chunk sizing or a bogus reshape later.
-    if total_size <= 0 or parity_num <= 0 or native_num <= 0:
+    # total_size == 0 is a VALID foreign archive: the reference encoder
+    # sizes by ftell with no empty-file guard (cpu-rs.c:492-495,
+    # encode.cu's analogous stat), so an empty input yields totalSize=0
+    # metadata with zero-byte chunks; decode has a zero-size fast path.
+    if total_size < 0 or parity_num <= 0 or native_num <= 0:
         raise ValueError(
             f"metadata fields out of range in {path!r}: size={total_size} "
-            f"p={parity_num} k={native_num} (all must be positive)"
+            f"p={parity_num} k={native_num} (size >= 0, p/k > 0)"
         )
     if native_num + parity_num > 65536:
         raise ValueError(

@@ -260,6 +260,48 @@ def main() -> None:
         assert "_2_" not in auto_conf, f"corrupt chunk kept: {auto_conf}"
     multihost_utils.sync_global_devices("auto_checked")
 
+    # --- lead-error lockstep, auto-decode: an UNRECOVERABLE archive (fewer
+    # than k healthy chunks) fails only in the lead's scan/selection; the
+    # ok/error broadcast must turn that into an exception on EVERY process
+    # instead of wedging the peers at the conf barrier ----------------------
+    broken_dir = os.path.join(workdir, "broken")
+    bpath = os.path.join(broken_dir, "payload.bin")
+    if pid == 0:
+        os.makedirs(broken_dir, exist_ok=True)
+        with open(bpath, "wb") as fp:
+            fp.write(payload[:4096])
+        api.encode_file(bpath, kf, pf, checksums=True)
+        for i in range(pf + 1):  # leaves kf-1 healthy chunks: unrecoverable
+            os.remove(chunk_file_name(bpath, i))
+    multihost_utils.sync_global_devices("broken_setup")
+    try:
+        api.auto_decode_file(
+            bpath, os.path.join(workdir, "never2.bin"),
+            mesh=mesh, segment_bytes=128 * 1024,
+        )
+        raise AssertionError("unrecoverable archive auto-decoded")
+    except (ValueError, RuntimeError):
+        pass  # lead re-raises the scan error; peers get the lockstep error
+    multihost_utils.sync_global_devices("broken_checked")
+
+    # --- lead-error lockstep, repair: a matrix entry out of the GF(2^8)
+    # range passes the peers' metadata parse (uint16 cap) but fails the
+    # range check inside the lead's scan — the -1 state sentinel must raise
+    # everywhere instead of wedging the health broadcast --------------------
+    if pid == 0:
+        meta = bpath + ".METADATA"
+        toks = open(meta).read().split()
+        toks[3] = "300"  # first matrix entry: > 255, out of range for w=8
+        with open(meta, "w") as fp:
+            fp.write(" ".join(toks) + "\n")
+    multihost_utils.sync_global_devices("badmat_setup")
+    try:
+        api.repair_file(bpath, mesh=mesh, segment_bytes=128 * 1024)
+        raise AssertionError("out-of-range matrix repaired")
+    except (ValueError, RuntimeError):
+        pass
+    multihost_utils.sync_global_devices("badmat_checked")
+
     print("MULTIHOST_OK", flush=True)
 
 

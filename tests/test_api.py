@@ -748,3 +748,73 @@ def test_backend_label_prefers_device_platform(monkeypatch):
 
     monkeypatch.setattr(jax, "devices", _boom)
     assert b.tpu_devices_present() is False  # failure -> portable path
+
+
+def test_decode_zero_size_foreign_archive(tmp_path):
+    # A reference encode of an empty file: totalSize=0 sizes-only metadata
+    # plus zero-byte chunks (cpu-rs.c:492-495 has no empty-file guard).
+    # Decode must rebuild the empty original, not crash on empty memmaps.
+    f = str(tmp_path / "empty.bin")
+    (tmp_path / "empty.bin.METADATA").write_text("0 2 4\n")
+    for i in range(6):
+        (tmp_path / f"_{i}_empty.bin").write_bytes(b"")
+    conf = str(tmp_path / "conf")
+    with open(conf, "w") as fp:
+        fp.write("".join(f"_{i}_empty.bin\n" for i in range(4)))
+    out = api.decode_file(f, conf, str(tmp_path / "out.bin"))
+    assert os.path.getsize(out) == 0
+    # Overwrite-input default path too (no pre-existing in_file needed).
+    out2 = api.decode_file(f, conf)
+    assert out2 == f and os.path.getsize(f) == 0
+
+
+def test_zero_size_foreign_archive_repair_scrub_auto(tmp_path):
+    # The same zero-byte foreign archive through the archive-maintenance
+    # surface: scrub reports it decodable, repair recreates deleted chunks
+    # (as empty files), auto-decode rebuilds the empty original.
+    f = str(tmp_path / "empty.bin")
+    (tmp_path / "empty.bin.METADATA").write_text("0 2 4\n")
+    for i in range(6):
+        (tmp_path / f"_{i}_empty.bin").write_bytes(b"")
+    report = api.scan_file(f)
+    assert report["decodable"] is True and report["missing"] == []
+    os.remove(str(tmp_path / "_1_empty.bin"))
+    os.remove(str(tmp_path / "_5_empty.bin"))
+    assert api.repair_file(f) == [1, 5]
+    for i in (1, 5):
+        p = str(tmp_path / f"_{i}_empty.bin")
+        assert os.path.exists(p) and os.path.getsize(p) == 0
+    out = api.auto_decode_file(f, str(tmp_path / "out.bin"))
+    assert os.path.getsize(out) == 0
+
+
+def test_zero_size_decode_still_enforces_contracts(tmp_path):
+    # The fast path must not skip validation: a conf naming absent chunks
+    # fails, and verify_checksums=True without CRC lines fails.
+    f = str(tmp_path / "empty.bin")
+    (tmp_path / "empty.bin.METADATA").write_text("0 2 4\n")
+    for i in range(4):
+        (tmp_path / f"_{i}_empty.bin").write_bytes(b"")
+    conf = str(tmp_path / "conf")
+    with open(conf, "w") as fp:
+        fp.write("".join(f"_{i}_empty.bin\n" for i in range(4)))
+    with pytest.raises(ValueError, match="no checksum lines"):
+        api.decode_file(f, conf, str(tmp_path / "o"), verify_checksums=True)
+    badconf = str(tmp_path / "badconf")
+    with open(badconf, "w") as fp:
+        fp.write("_0_empty.bin\n_1_empty.bin\n_2_empty.bin\n_9_nope.bin\n")
+    with pytest.raises(FileNotFoundError):
+        api.decode_file(f, badconf, str(tmp_path / "o"))
+
+
+def test_zero_size_repair_enforces_k_healthy(tmp_path):
+    # Repairability must match scan_file's decodable verdict: a zero-size
+    # archive with fewer than k healthy chunks cannot produce a valid
+    # k-chunk conf, so repair refuses it too (no zero-survivor rebuild).
+    f = str(tmp_path / "empty.bin")
+    (tmp_path / "empty.bin.METADATA").write_text("0 2 4\n")
+    for i in range(3):  # only 3 of the k=4 needed
+        (tmp_path / f"_{i}_empty.bin").write_bytes(b"")
+    assert api.scan_file(f)["decodable"] is False
+    with pytest.raises(ValueError, match="healthy"):
+        api.repair_file(f)

@@ -65,7 +65,7 @@ def test_watchdog_emits_error_line_and_exits():
     env.pop("RS_BENCH_NO_FALLBACK", None)
     run = subprocess.run(
         [sys.executable, "-c", code], env=env, capture_output=True,
-        text=True, timeout=30,
+        text=True, timeout=30, cwd=REPO,
     )
     assert run.returncode == 1
     line = json.loads(run.stdout.strip().splitlines()[0])
@@ -87,7 +87,7 @@ def test_watchdog_emits_held_result_instead_of_error():
     env = dict(os.environ, RS_BENCH_WATCHDOG_S="1", PYTHONPATH="")
     run = subprocess.run(
         [sys.executable, "-c", code], env=env, capture_output=True,
-        text=True, timeout=30,
+        text=True, timeout=30, cwd=REPO,
     )
     assert run.returncode == 0
     line = json.loads(run.stdout.strip().splitlines()[0])

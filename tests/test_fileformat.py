@@ -116,3 +116,12 @@ def test_metadata_chunk_cap_is_width_aware(tmp_path):
     path.write_text("1024 300 2\n# gfwidth 16\n")
     total_size, p, k, mat, w, crcs = read_metadata_ext(str(path))
     assert (p, k, w) == (300, 2, 16)
+
+def test_metadata_zero_size_foreign_archive_accepted(tmp_path):
+    # The reference encoder sizes its input by ftell with no empty-file
+    # guard (cpu-rs.c:492-495), so an empty input yields totalSize=0
+    # sizes-only metadata — a valid foreign archive, not a hostile header.
+    path = tmp_path / "z.METADATA"
+    path.write_text("0 2 4\n")
+    total_size, p, k, mat, w, crcs = read_metadata_ext(str(path))
+    assert (total_size, p, k, mat, w) == (0, 2, 4, None, 8)
