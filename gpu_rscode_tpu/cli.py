@@ -9,8 +9,10 @@ was selected first, matching the reference's ordering rule.
 
 Extensions (flagged long options, no reference equivalent):
 ``--generator {vandermonde,cauchy}``,
-``--strategy {auto,bitplane,table,pallas,cpu}`` (default auto: pallas on a
-TPU backend, bitplane elsewhere/on meshes), ``--devices N`` / ``--stripe S``
+``--strategy {auto,bitplane,table,pallas,cpu}`` (default auto: the fused
+pallas kernel on TPU hardware, meshes included — every fused dispatch is
+guarded with a bitplane fallback — bitplane elsewhere), ``--devices N`` /
+``--stripe S``
 (mesh sharding), ``--quiet`` (suppress the timing report),
 ``--profile-dir DIR`` (jax.profiler trace output).
 """
@@ -35,7 +37,8 @@ Performance-tuning options:
 [-s|-S]: pipeline depth (segments in flight, default 2)
 Extensions: [--generator vandermonde|cauchy]
             [--strategy auto|bitplane|table|pallas|cpu]  (default auto:
-            pallas kernel on TPU, bitplane elsewhere; cpu = host codec)
+            pallas kernel on TPU incl. meshes, bitplane elsewhere;
+            cpu = host codec)
             [--segment-bytes N] [--quiet] [--profile-dir DIR]
             [--devices N] [--stripe S]  (shard over a device mesh;
             S > 1 additionally shards the stripe/k axis)
@@ -46,7 +49,9 @@ Extensions: [--generator vandermonde|cauchy]
             [--auto] (decode without -c: discover healthy chunks, skip
             corrupt ones via CRC32, pick a decodable subset)
             [--repair] (with -i: rebuild every lost/corrupt chunk in place,
-            parity included; refreshes CRC lines)
+            parity included; refreshes CRC lines.  Extra positional files
+            after the flags repair a whole fleet: all survivor-matrix
+            inversions run in one batched device dispatch)
             [--scrub]  (with -i: read-only health report as one JSON line)
 """
 
@@ -60,7 +65,10 @@ def _fail(msg: str) -> "int":
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     try:
-        opts, extra = getopt.getopt(
+        # gnu_getopt: flags may follow the fleet-repair positional archives
+        # (the reference surface has no positionals, so ordering semantics
+        # for its flags are unchanged — opts keeps argv order).
+        opts, extra = getopt.gnu_getopt(
             argv,
             "S:s:P:p:K:k:N:n:E:e:I:i:C:c:O:o:DdHh",
             [
@@ -81,7 +89,7 @@ def main(argv: list[str] | None = None) -> int:
         )
     except getopt.GetoptError as e:
         return _fail(f"rs: {e}")
-    if extra:
+    if extra and not any(fl == "--repair" for fl, _ in opts):
         return _fail(f"rs: unexpected arguments {extra}")
 
     native_num = total_num = 0
@@ -164,6 +172,10 @@ def main(argv: list[str] | None = None) -> int:
         if op == "encode" or auto or conf_file or out_file:
             return _fail("rs: --repair takes only -i (plus tuning flags)")
         op = "repair"
+        if extra and n_devices:
+            # Rejected HERE, before distributed.initialize()/make_mesh can
+            # block or raise: the batched fleet path is single-host.
+            return _fail("rs: fleet --repair does not take --devices")
     if scrub:
         if op == "encode" or auto or conf_file or out_file:
             return _fail("rs: --scrub takes only -i")
@@ -252,11 +264,27 @@ def main(argv: list[str] | None = None) -> int:
             # "unknown" (subset search capped) is not proven healthy -> 1.
             return 0 if report["decodable"] is True else 1
         elif op == "repair":
-            rebuilt = api.repair_file(in_file, timer=timer, **kwargs)
-            print(
-                f"rebuilt chunks: {rebuilt}" if rebuilt else "archive healthy"
-            )
-            nbytes = os.path.getsize(in_file) if os.path.exists(in_file) else 0
+            if extra:
+                # Fleet mode: -i <first> plus positional archives (the
+                # --devices combination was rejected at validation, so
+                # kwargs carries no mesh here).
+                fleet = [in_file] + list(extra)
+                results = api.repair_fleet(fleet, timer=timer, **kwargs)
+                for f in fleet:
+                    reb = results[f]
+                    print(f"{f}: rebuilt {reb}" if reb else f"{f}: healthy")
+                nbytes = sum(
+                    os.path.getsize(f) for f in fleet if os.path.exists(f)
+                )
+            else:
+                rebuilt = api.repair_file(in_file, timer=timer, **kwargs)
+                print(
+                    f"rebuilt chunks: {rebuilt}"
+                    if rebuilt else "archive healthy"
+                )
+                nbytes = (
+                    os.path.getsize(in_file) if os.path.exists(in_file) else 0
+                )
         else:
             if not in_file or (not conf_file and not auto):
                 return _fail("rs: decoding requires -i and -c (or --auto)")

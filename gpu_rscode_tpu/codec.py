@@ -79,15 +79,13 @@ class RSCodec:
         if native_num < 1 or parity_num < 0:
             raise ValueError(f"bad (k={native_num}, p={parity_num})")
         if strategy == "auto":
-            # Mesh runs resolve to bitplane: the sharded body has no
-            # Mosaic-failure fallback (a mid-stream kernel failure would
-            # leave partial output files).  Explicit strategy="pallas" works
-            # on meshes — both sharding modes (the stripe mode via the
-            # kernel's pre-parity output) — for callers who accept that.
-            if mesh is not None or not _tpu_devices_present():
-                strategy = "bitplane"
-            else:
-                strategy = "pallas"
+            # The fused kernel is the production default on real TPU
+            # hardware, mesh or not — the reference's multi-GPU mode runs
+            # its fast kernel unconditionally (decode.cu:335-378).  Both
+            # paths guard every fused dispatch: a Mosaic-class failure
+            # demotes to bitplane and recomputes the same bytes (see
+            # _matmul), so no kernel failure can corrupt output files.
+            strategy = "pallas" if _tpu_devices_present() else "bitplane"
         self.gf = get_field(w)
         self.w = w
         self.native_num = native_num
@@ -181,6 +179,37 @@ class RSCodec:
         if pad:
             B = np.pad(np.asarray(B), ((0, 0), (0, pad)))
         Bd = put_sharded(B, self.mesh, self.stripe_sharded)
+        if self.strategy == "pallas":
+            # Same guard discipline as the single-device path: every
+            # pallas dispatch (including tail segments, which recompile
+            # for their different padded shape) demotes to bitplane on a
+            # Mosaic-class failure and recomputes — output bytes are
+            # identical either way, so even a mid-stream demotion cannot
+            # corrupt files.  The FIRST dispatch is materialised inside
+            # the guard so the common failure mode (compile) resolves
+            # before any caller writes output; later segments run async
+            # and a runtime wedge would surface at consumption, as on the
+            # single-device path.
+            try:
+                out = sharded_gf_matmul(
+                    np.asarray(A), Bd, mesh=self.mesh, w=self.w,
+                    strategy="pallas", stripe_sharded=self.stripe_sharded,
+                )
+                if not self._pallas_checked:
+                    jax.block_until_ready(out)
+                    self._pallas_checked = True
+                return out[:, :m] if pad else out
+            except Exception as e:
+                if not isinstance(e, _pallas_failure_types()):
+                    raise
+                import warnings
+
+                warnings.warn(
+                    f"sharded pallas GEMM failed ({type(e).__name__}); "
+                    "demoting to the XLA bitplane path",
+                    stacklevel=3,
+                )
+                self.strategy = "bitplane"
         out = sharded_gf_matmul(
             np.asarray(A),
             Bd,

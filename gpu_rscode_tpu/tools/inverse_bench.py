@@ -1,0 +1,104 @@
+"""Batched on-device k x k inversion vs the host loop — repair_fleet's win.
+
+``api.repair_fleet`` solves every damaged archive's survivor-subset inverse
+in one vmapped device dispatch (``ops.inverse.invert_matrix_jax_batch``, the
+production reincarnation of the reference's dormant GPU inverter
+matrix.cu:667-744 / blocked experiment decode-gj.cu:1059-1201).  This tool
+measures that amortisation: B random invertible k x k GF(2^8) survivor
+submatrices inverted (a) on device in one dispatch, (b) on host one
+``invert_matrix`` call at a time — the two paths repair_fleet chooses
+between.
+
+Usage: python -m gpu_rscode_tpu.tools.inverse_bench [--batch 256] [--k 32]
+Prints one JSON line per (batch, k) combination (commented-jsonl capture
+convention: ``#`` lines are context, data lines are JSON).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, nargs="+", default=[64, 256, 1024])
+    ap.add_argument("--k", type=int, nargs="+", default=[10, 32, 128])
+    ap.add_argument("--trials", type=int, default=3)
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from ..models.vandermonde import total_matrix
+    from ..ops.gf import get_field
+    from ..ops.inverse import invert_matrix, invert_matrix_jax_batch
+    from ..utils.backend import backend_label
+
+    import jax
+
+    label = backend_label()
+    print(f"# backend={label}", file=sys.stderr, flush=True)
+    gf = get_field(8)
+    rng = np.random.default_rng(0)
+
+    for k in args.k:
+        # Survivor submatrices of the (2k, k) total matrix: the exact shape
+        # repair_fleet inverts (k rows chosen from natives+parity).
+        T = total_matrix(k, k, gf)
+        n = 2 * k
+        for batch in args.batch:
+            subs = np.stack([
+                T[np.sort(rng.choice(n, size=k, replace=False))]
+                for _ in range(batch)
+            ])
+            dev_subs = jax.device_put(subs)
+
+            def run():
+                invs, oks = invert_matrix_jax_batch(dev_subs, 8)
+                return jax.block_until_ready(invs), np.asarray(oks)
+
+            invs, oks = run()  # warmup/compile
+            dev_best = min(
+                _timed(run) for _ in range(args.trials)
+            )
+
+            ok_idx = np.flatnonzero(oks)
+            t0 = time.perf_counter()
+            for j in ok_idx:
+                invert_matrix(subs[j], gf)
+            host_s = time.perf_counter() - t0
+            host_per = host_s / max(1, len(ok_idx))
+
+            # Bit-exactness of the device inverses vs the host inverter on
+            # a sample (repair_fleet additionally verifies every inverse
+            # with one GF matmul before trusting it).
+            for j in ok_idx[:4]:
+                want = invert_matrix(subs[j], gf)
+                got = np.asarray(invs[j]).astype(gf.dtype)
+                assert np.array_equal(got, want), f"inverse mismatch at {j}"
+
+            print(json.dumps({
+                "metric": f"batched_inverse_{label}",
+                "k": k,
+                "batch": batch,
+                "invertible": int(len(ok_idx)),
+                "device_dispatch_s": round(dev_best, 6),
+                "device_per_matrix_us": round(1e6 * dev_best / batch, 2),
+                "host_per_matrix_us": round(1e6 * host_per, 2),
+                "speedup_vs_host_loop": round(
+                    host_per * batch / dev_best, 2
+                ),
+            }), flush=True)
+    return 0
+
+
+def _timed(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -818,3 +818,91 @@ def test_zero_size_repair_enforces_k_healthy(tmp_path):
     assert api.scan_file(f)["decodable"] is False
     with pytest.raises(ValueError, match="healthy"):
         api.repair_file(f)
+
+
+def test_repair_fleet_batched_inversion(tmp_path):
+    """Fleet scrub-and-repair: all survivor inversions of a (k, w) config
+    solved in one batched on-device dispatch, rebuilds byte-identical."""
+    from gpu_rscode_tpu.utils.fileformat import metadata_file_name
+
+    configs = [(4, 2, 5000), (4, 2, 7001), (6, 3, 9000)]
+    paths, golden = [], {}
+    for k, p, size in configs:
+        path = _mkfile(tmp_path, size, seed=size)
+        api.encode_file(path, k, p, checksums=True)
+        paths.append((path, k, p))
+        golden[path] = {
+            i: open(chunk_file_name(path, i), "rb").read()
+            for i in range(k + p)
+        }
+    # Damage: archive0 loses two chunks, archive1 gets one corrupted,
+    # archive2 stays healthy.
+    os.remove(chunk_file_name(paths[0][0], 0))
+    os.remove(chunk_file_name(paths[0][0], 5))
+    with open(chunk_file_name(paths[1][0], 2), "r+b") as fp:
+        fp.seek(3)
+        b = fp.read(1)[0]
+        fp.seek(3)
+        fp.write(bytes([b ^ 0xFF]))
+
+    from gpu_rscode_tpu.ops import inverse as inverse_mod
+
+    calls = []
+    real_batch = inverse_mod.invert_matrix_jax_batch
+
+    def counting_batch(Ms, w=8):
+        calls.append(np.asarray(Ms).shape)
+        return real_batch(Ms, w)
+
+    import gpu_rscode_tpu.api as api_mod
+    old = inverse_mod.invert_matrix_jax_batch
+    inverse_mod.invert_matrix_jax_batch = counting_batch
+    try:
+        results = api.repair_fleet([p for p, _, _ in paths])
+    finally:
+        inverse_mod.invert_matrix_jax_batch = old
+
+    assert results[paths[0][0]] == [0, 5]
+    assert results[paths[1][0]] == [2]
+    assert results[paths[2][0]] == []
+    # Two damaged archives share (k=4, w=8): ONE batched dispatch of 2.
+    assert calls == [(2, 4, 4)], calls
+    for path, k, p in paths:
+        for i in range(k + p):
+            assert (
+                open(chunk_file_name(path, i), "rb").read() == golden[path][i]
+            ), f"{path} chunk {i}"
+
+
+def test_repair_fleet_all_or_nothing(tmp_path):
+    """An unrecoverable archive anywhere in the fleet aborts the whole pass
+    before any rebuild is written."""
+    a = _mkfile(tmp_path, 4000, seed=1)
+    b = _mkfile(tmp_path, 6000, seed=2)
+    api.encode_file(a, 4, 2)
+    api.encode_file(b, 4, 2)
+    os.remove(chunk_file_name(a, 1))          # recoverable damage
+    for i in range(3):                         # unrecoverable: 3 of 6 gone
+        os.remove(chunk_file_name(b, i))
+    with pytest.raises(ValueError, match="unrecoverable archives"):
+        api.repair_fleet([a, b])
+    # All-or-nothing: a's damaged chunk was NOT rebuilt.
+    assert not os.path.exists(chunk_file_name(a, 1))
+    # Repairing only the healthy-enough archive then succeeds.
+    assert api.repair_fleet([a]) == {a: [1]}
+    assert os.path.exists(chunk_file_name(a, 1))
+
+
+def test_repair_fleet_zero_size_all_or_nothing(tmp_path):
+    """An unrecoverable ZERO-SIZE archive must abort the fleet pass during
+    validation (before any rebuild), same as a normal unrecoverable one."""
+    a = _mkfile(tmp_path, 4000, seed=11)
+    api.encode_file(a, 4, 2)
+    os.remove(chunk_file_name(a, 1))  # recoverable damage
+    z = str(tmp_path / "empty.bin")
+    (tmp_path / "empty.bin.METADATA").write_text("0 2 4\n")
+    for i in range(3):  # 3 healthy < k=4 and chunk 5 missing -> unhealthy
+        (tmp_path / f"_{i}_empty.bin").write_bytes(b"")
+    with pytest.raises(ValueError, match="unrecoverable archives"):
+        api.repair_fleet([a, z])
+    assert not os.path.exists(chunk_file_name(a, 1))  # nothing repaired

@@ -153,3 +153,30 @@ def test_cli_scrub_rejects_devices(tmp_path):
     from gpu_rscode_tpu import cli
 
     assert cli.main(["--scrub", "-i", "whatever", "--devices", "8"]) == 2
+
+
+def test_cli_repair_fleet(tmp_path, capsys):
+    """--repair with extra positional archives heals the whole fleet (one
+    batched inversion dispatch under the hood)."""
+    import os
+
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    a = str(tmp_path / "a.bin")
+    b = str(tmp_path / "b.bin")
+    rng = np.random.default_rng(7)
+    for p in (a, b):
+        open(p, "wb").write(
+            rng.integers(0, 256, size=5000, dtype=np.uint8).tobytes()
+        )
+        assert main(["-k", "4", "-n", "6", "-e", p, "--quiet"]) == 0
+    os.remove(chunk_file_name(a, 2))
+    assert main(["--repair", "-i", a, b, "--quiet"]) == 0
+    out = capsys.readouterr().out
+    assert f"{a}: rebuilt [2]" in out and f"{b}: healthy" in out
+    assert os.path.exists(chunk_file_name(a, 2))
+
+
+def test_cli_fleet_positionals_require_repair(tmp_path):
+    assert main(["-d", "-i", "x", "-c", "y", "z.bin"]) == 2
+    assert main(["--repair", "-i", "x", "y.bin", "--devices", "2"]) == 2

@@ -143,3 +143,69 @@ def test_wide_symbol_codec_w4_and_w16():
         dec = codec.decode_matrix(surv)
         rec = np.asarray(codec.decode(dec, code[surv]))
         np.testing.assert_array_equal(rec.astype(gf.dtype), natives)
+
+
+def test_auto_on_mesh_resolves_to_pallas_on_tpu(monkeypatch):
+    """VERDICT r3 item 3: strategy='auto' with a mesh must pick the fused
+    kernel when real TPU devices are present (the reference's multi-GPU
+    mode runs its fast kernel unconditionally, decode.cu:335-378)."""
+    from gpu_rscode_tpu import codec as codec_mod
+    from gpu_rscode_tpu.codec import RSCodec
+
+    mesh = make_mesh(8)
+    monkeypatch.setattr(codec_mod, "_tpu_devices_present", lambda: True)
+    c = RSCodec(4, 2, strategy="auto", mesh=mesh)
+    assert c.strategy == "pallas"
+    monkeypatch.setattr(codec_mod, "_tpu_devices_present", lambda: False)
+    c2 = RSCodec(4, 2, strategy="auto", mesh=mesh)
+    assert c2.strategy == "bitplane"
+
+
+def test_mesh_pallas_validate_once_demotes_at_startup(monkeypatch):
+    """The sharded validate-once gate: a Mosaic-class failure on the FIRST
+    dispatch demotes to bitplane with a warning and still returns correct
+    output; the demotion is sticky (no per-segment retries)."""
+    from gpu_rscode_tpu.codec import RSCodec
+    from gpu_rscode_tpu.parallel import sharded as sharded_mod
+
+    real = sharded_mod.sharded_gf_matmul
+    calls = []
+
+    def fake(A, B, *, mesh, w=8, strategy="bitplane", stripe_sharded=False):
+        calls.append(strategy)
+        if strategy == "pallas":
+            raise NotImplementedError("synthetic Mosaic lowering failure")
+        return real(
+            A, B, mesh=mesh, w=w, strategy=strategy,
+            stripe_sharded=stripe_sharded,
+        )
+
+    monkeypatch.setattr(sharded_mod, "sharded_gf_matmul", fake)
+    mesh = make_mesh(8)
+    A, B, want = _case(4, 10, 8 * 256, seed=7)
+    c = RSCodec(10, 4, strategy="pallas", mesh=mesh)
+    with pytest.warns(UserWarning, match="demoting to the XLA bitplane"):
+        got = np.asarray(c._matmul(A, B))
+    np.testing.assert_array_equal(got, want)
+    assert c.strategy == "bitplane"
+    # Second segment: no pallas retry, straight to the demoted strategy.
+    got2 = np.asarray(c._matmul(A, B))
+    np.testing.assert_array_equal(got2, want)
+    assert calls == ["pallas", "bitplane", "bitplane"]
+
+
+def test_mesh_pallas_non_mosaic_failure_propagates(monkeypatch):
+    """Only known backend/Mosaic failure types demote — a programming error
+    (TypeError) must propagate, not silently fall back."""
+    from gpu_rscode_tpu.codec import RSCodec
+    from gpu_rscode_tpu.parallel import sharded as sharded_mod
+
+    def boom(A, B, **kw):
+        raise TypeError("shape bug")
+
+    monkeypatch.setattr(sharded_mod, "sharded_gf_matmul", boom)
+    mesh = make_mesh(8)
+    A, B, _ = _case(4, 10, 8 * 256, seed=8)
+    c = RSCodec(10, 4, strategy="pallas", mesh=mesh)
+    with pytest.raises(TypeError, match="shape bug"):
+        c._matmul(A, B)
