@@ -29,7 +29,7 @@ def _mark(phase: str) -> None:
     print(f"# [{_time_mod.time() - _T0:7.1f}s] {phase}", file=sys.stderr, flush=True)
 
 
-# One-line contract, enforced: success, failure, second-chance forward and
+# One-line contract, enforced: success, failure, retry-loop forward and
 # the wedge watchdog all race to this gate; the first wins, the rest no-op.
 _EMIT_LOCK = threading.Lock()
 _EMITTED = False
@@ -77,7 +77,7 @@ def _committed_tpu_captures() -> list:
 _PARTIAL = None  # (backend, best, detail) once a VERIFIED number exists
 
 
-def _arm_wedge_watchdog() -> None:
+def _arm_wedge_watchdog(delay: float | None = None) -> None:
     """Emit the JSON line even if the device WEDGES mid-measurement.
 
     The probe protects against a tunnel that is down at start; this guards
@@ -90,19 +90,25 @@ def _arm_wedge_watchdog() -> None:
     * the held result (exit 0) when a verified encode number is already in
       hand (``_PARTIAL``, a snapshot re-published as each strategy/decode
       result lands) — a wedge during a later strategy, decode timing or a
-      long second-chance phase must not discard the round's headline
-      measurement;
+      long retry phase must not discard the round's headline measurement;
     * otherwise the error line with pointers to the committed hardware
       captures (exit 1).
 
-    Armed unconditionally: in the second-chance child the parent's 300 s
-    subprocess timeout expires long before this fires, and a direct
-    hardware-only run (RS_BENCH_NO_FALLBACK) is the MOST exposed to a
-    wedge, not the least.
+    Armed unconditionally: in the hardware child the parent's subprocess
+    timeout expires long before this fires, and a direct hardware-only run
+    (RS_BENCH_NO_FALLBACK) is the MOST exposed to a wedge, not the least.
+
+    Re-arming (``delay`` seconds from NOW) replaces the pending timer: the
+    retry loop extends the deadline before launching a hardware child so a
+    watchdog armed for the base budget cannot latch the held CPU line
+    while the child is about to deliver the TPU line (ADVICE r3).
     """
     import os
 
-    budget = float(os.environ.get("RS_BENCH_WATCHDOG_S", "480"))
+    budget = (
+        delay if delay is not None
+        else float(os.environ.get("RS_BENCH_WATCHDOG_S", "480"))
+    )
 
     def fire() -> None:
         held = _PARTIAL  # read once; main keeps re-binding fresh snapshots
@@ -146,6 +152,8 @@ def _arm_wedge_watchdog() -> None:
             os._exit(1)
 
     global _WATCHDOG
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()
     _WATCHDOG = threading.Timer(budget, fire)
     _WATCHDOG.daemon = True
     _WATCHDOG.start()
@@ -159,24 +167,25 @@ K, P = 10, 4
 BASELINE_GBPS = 1.356835
 
 
-def _probe_backend(env_platform=None, timeout=120):
-    """Check in a throwaway subprocess that jax backend init succeeds AND
-    terminates.  A busy axon tunnel makes client-create BLOCK rather than
-    raise (the MULTICHIP_r01 rc=124 mode), and an in-process hang could never
-    be recovered — hence the subprocess.  Returns (backend_name|None, hung).
+_PROBE_HUNG = object()  # sentinel: the probe subprocess had to be killed
+
+
+def _probe_subprocess(code: str, env: dict, timeout: float):
+    """Run a tiny probe script in a throwaway subprocess.  A busy axon
+    tunnel makes jax client-create BLOCK rather than raise (the
+    MULTICHIP_r01 rc=124 mode), and an in-process hang could never be
+    recovered — hence the subprocess.  Returns the last stdout line,
+    ``_PROBE_HUNG`` on timeout, or ``None`` with the stderr tail printed
+    on nonzero exit.
 
     The child is stopped with SIGTERM (grace, then SIGKILL only as a last
     resort) — a blocked client is *waiting* for the tunnel lease, not
     holding it, so terminating it does not wedge the lease.
     """
-    import os
     import subprocess
 
-    env = dict(os.environ)
-    if env_platform is not None:
-        env["JAX_PLATFORMS"] = env_platform
     p = subprocess.Popen(
-        [sys.executable, "-c", "import jax; print(jax.default_backend())"],
+        [sys.executable, "-c", code],
         env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
     )
     try:
@@ -187,13 +196,30 @@ def _probe_backend(env_platform=None, timeout=120):
             p.wait(timeout=15)
         except subprocess.TimeoutExpired:
             p.kill()
-        print(f"# backend probe hung >{timeout}s (tunnel busy?)", file=sys.stderr)
-        return None, True
+        print(f"# backend probe hung >{timeout}s (tunnel busy?)",
+              file=sys.stderr)
+        return _PROBE_HUNG
     if p.returncode != 0:
-        print(f"# backend probe failed: {err.strip()[-200:]}", file=sys.stderr)
-        return None, False
-    name = out.strip().splitlines()[-1] if out.strip() else None
-    return name, False
+        print(f"# backend probe failed: {err.strip()[-200:]}",
+              file=sys.stderr)
+        return None
+    return out.strip().splitlines()[-1] if out.strip() else None
+
+
+def _probe_backend(env_platform=None, timeout=120):
+    """Probe which jax backend would initialise.  Returns
+    (backend_name|None, hung)."""
+    import os
+
+    env = dict(os.environ)
+    if env_platform is not None:
+        env["JAX_PLATFORMS"] = env_platform
+    got = _probe_subprocess(
+        "import jax; print(jax.default_backend())", env, timeout
+    )
+    if got is _PROBE_HUNG:
+        return None, True
+    return got, False
 
 
 def _init_backend():
@@ -204,25 +230,30 @@ def _init_backend():
     tunnel failure mode blocks forever.  Each candidate backend is first
     probed in a subprocess with a timeout; only a probe that comes back
     healthy is initialised in-process.  Falls back to forced cpu with the
-    axon factory deregistered (a later TPU second chance happens at emit
-    time, see _second_chance_tpu).  Returns (jax, backend_name); the bench
+    axon factory deregistered (TPU retries continue at emit time, see
+    _tpu_retry_until_deadline).  Returns (jax, backend_name); the bench
     ALWAYS emits its JSON line with whatever backend this lands on.
     """
     import os
     import time
 
     def _no_fallback_guard(name: str) -> None:
-        # The second-chance child must never measure on CPU under ANY of the
+        # The hardware child must never measure on CPU under ANY of the
         # probe paths, not just the forced-cpu last resort — a tunnel that
         # flaps back down between the parent's probe and the child's start
         # would otherwise make the child burn its whole timeout re-running
-        # the CPU bench (and recursing into its own second chance).
+        # the CPU bench (and recursing into its own retry loop).
         if os.environ.get("RS_BENCH_NO_FALLBACK") and name == "cpu":
             raise SystemExit("probe landed on cpu and RS_BENCH_NO_FALLBACK set")
 
     hung = False
-    for attempt in range(3):
-        name, hung = _probe_backend()
+    for attempt in range(2):
+        # 75 s per probe, 2 attempts: a healthy tunnel answers in ~10-30 s;
+        # anything slower is the wedge mode, and every second burned here
+        # comes out of the retry loop's window (the r03 postmortem: a
+        # single 120 s probe + one-shot second chance consumed the budget
+        # that staggered retries should have had).
+        name, hung = _probe_backend(timeout=75)
         if name:
             _no_fallback_guard(name)
             import jax
@@ -234,15 +265,15 @@ def _init_backend():
             jax.devices()
             return jax, jax.default_backend()
         if hung:
-            # A wedged tunnel does not un-wedge in seconds, and auto-pick
-            # would dial it again — go straight to the defused cpu path so
-            # the JSON line appears well inside any driver timeout.
+            # A wedged tunnel does not un-wedge in seconds — fall through
+            # to the defused cpu path NOW; the retry loop keeps probing for
+            # the rest of the budget after the CPU line is in hand.
             break
-        if attempt < 2:
-            time.sleep(5.0 * (attempt + 1))
+        if attempt < 1:
+            time.sleep(5.0)
     if not hung:
         # Auto-pick ('' = let jax choose any available platform).
-        name, hung = _probe_backend(env_platform="", timeout=60)
+        name, hung = _probe_backend(env_platform="", timeout=45)
         if name:
             _no_fallback_guard(name)
             import jax
@@ -252,7 +283,7 @@ def _init_backend():
             jax.devices()
             return jax, jax.default_backend()
     if os.environ.get("RS_BENCH_NO_FALLBACK"):
-        # The second-chance child must never report a CPU number (its parent
+        # The hardware child must never report a CPU number (its parent
         # already holds one) — fail fast instead.
         raise SystemExit("no TPU backend and RS_BENCH_NO_FALLBACK set")
     # Last resort: forced cpu, axon factory removed so nothing can dial the
@@ -265,75 +296,122 @@ def _init_backend():
     return jax, jax.default_backend()
 
 
-def _second_chance_tpu() -> bool:
-    """One more try at the hardware before settling for a CPU line.
+def _probe_tpu_once(timeout: float = 60.0) -> str:
+    """Subprocess probe for the device platform ('' on failure/hang).  The
+    fallback path pinned JAX_PLATFORMS=cpu in os.environ — the probe child
+    must not inherit that or it can only ever answer "cpu"."""
+    import os
 
-    Round-2 postmortem: the tunnel hung once at t=0 and the bench shipped a
-    CPU number even though the tunnel may have recovered minutes later while
-    the CPU strategies ran.  With the CPU result safely in hand, re-probe;
-    if healthy, re-run the whole bench in a child process (fresh interpreter
-    — this one's jax is pinned to the defused cpu backend) and forward its
-    TPU JSON line as OUR single output line.  Returns True iff that
-    happened.  The child sets RS_BENCH_NO_FALLBACK so it can never recurse
-    into a second CPU measurement.
+    probe_env = dict(os.environ)
+    probe_env.pop("JAX_PLATFORMS", None)
+    got = _probe_subprocess(
+        "import jax; print(jax.devices()[0].platform.lower())",
+        probe_env, timeout,
+    )
+    return got if isinstance(got, str) else ""
 
-    Time-bounded so the held CPU line cannot be lost to a driver timeout
-    (the "ALWAYS emits its JSON line" contract): skipped entirely when the
-    bench has already burned >180 s, probe 60 s, child 300 s — worst case
-    adds ~6 min to a run that is otherwise done.
+
+# A hardware child needs this much wall at minimum (backend init ~30 s +
+# first kernel compiles ~40 s + timed strategies + decode); probing later
+# than budget - (this + margin) cannot produce a TPU line anymore.
+_MIN_CHILD_S = 150.0
+
+
+def _tpu_retry_until_deadline() -> bool:
+    """Keep probing for the tunnel across the WHOLE remaining budget.
+
+    Round-3 postmortem: the one-shot "second chance" probed exactly once,
+    ~60 s after the CPU result, against a tunnel that flaps on multi-minute
+    timescales — and the round shipped a 0.33x CPU line while committed
+    captures showed 47.7x on the same config.  With the CPU result safely
+    held (``_PARTIAL`` + watchdog), this loop probes every ~15 s until the
+    watchdog budget minus a minimum-viable child window is exhausted; on
+    the first healthy probe it re-runs the bench in a hardware-only child
+    (fresh interpreter — this one's jax is pinned to the defused cpu
+    backend; RS_BENCH_NO_FALLBACK so it can never recurse into a second
+    CPU measurement) and forwards the child's TPU JSON line as OUR single
+    output line.  Returns True iff that happened.
+
+    The watchdog is RE-ARMED to cover each child launch (ADVICE r3): a
+    timer armed for the base budget must not fire mid-child, latch the
+    held CPU line and discard the TPU line the child was about to produce.
+    On loop exhaustion the caller emits the held CPU line directly — the
+    watchdog stays as the wedge backstop, not the normal exit path.
     """
     import os
     import subprocess
 
-    if _time_mod.time() - _T0 > 180:
-        _mark("no time budget for a TPU second chance; keeping cpu line")
-        return False
-    # The fallback path pinned JAX_PLATFORMS=cpu in os.environ — the probe
-    # child must not inherit that or it can only ever answer "cpu".
-    probe_env = dict(os.environ)
-    probe_env.pop("JAX_PLATFORMS", None)
-    p = subprocess.Popen(
-        [sys.executable, "-c",
-         "import jax; print(jax.devices()[0].platform.lower())"],
-        env=probe_env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-        text=True,
-    )
-    try:
-        out, _err = p.communicate(timeout=60)
-    except subprocess.TimeoutExpired:
-        p.terminate()
-        try:
-            p.wait(timeout=15)
-        except subprocess.TimeoutExpired:
-            p.kill()
-        _mark("second-chance probe hung; keeping cpu line")
-        return False
-    platform = out.strip().splitlines()[-1] if (p.returncode == 0 and out.strip()) else ""
-    if platform != "tpu":
-        _mark(f"second-chance probe saw {platform or 'nothing'}; keeping cpu line")
-        return False
-    _mark("tunnel recovered (tpu devices); re-running on hardware")
-    env = dict(os.environ)
-    env["RS_BENCH_NO_FALLBACK"] = "1"
-    env.pop("JAX_PLATFORMS", None)
-    try:
-        run = subprocess.run(
-            [sys.executable, __file__],
-            env=env, capture_output=True, text=True, timeout=300,
+    budget = float(os.environ.get("RS_BENCH_WATCHDOG_S", "480"))
+    attempt = 0
+    while True:
+        elapsed = _time_mod.time() - _T0
+        remaining = budget - elapsed
+        # Reserve only a FAST probe (~30 s, the healthy-tunnel answer time),
+        # not the 60 s hung-probe worst case: a hung probe near the deadline
+        # means no child launches anyway (the viability check below), while
+        # a healthy late probe is exactly the flap this loop exists to catch.
+        if remaining < _MIN_CHILD_S + 40:
+            _mark(
+                f"retry window exhausted after {attempt} probe(s) "
+                f"({remaining:.0f}s left < child minimum); keeping cpu line"
+            )
+            return False
+        attempt += 1
+        platform = _probe_tpu_once(timeout=60)
+        if platform != "tpu":
+            _mark(f"probe {attempt}: saw {platform or 'nothing'}; retrying")
+            _time_mod.sleep(15.0)
+            continue
+        child_timeout = min(300.0, budget - (_time_mod.time() - _T0) - 15)
+        if child_timeout < _MIN_CHILD_S:
+            _mark(
+                f"tunnel healthy but only {child_timeout:.0f}s left — below "
+                f"the {_MIN_CHILD_S:.0f}s child minimum; keeping cpu line"
+            )
+            return False
+        # Extend the wedge deadline past the child's own timeout: the
+        # child is time-bounded by subprocess.run, so the parent cannot
+        # wedge here, and the held CPU line is emitted on every exit path.
+        _arm_wedge_watchdog(child_timeout + 60)
+        _mark(
+            f"probe {attempt}: tunnel healthy; hardware child "
+            f"(timeout {child_timeout:.0f}s)"
         )
-    except subprocess.TimeoutExpired:
-        _mark("second-chance run timed out; keeping cpu line")
-        return False
-    if run.returncode == 0:
-        for line in run.stdout.splitlines():
-            if line.startswith("{") and "_tpu" in line.split(",")[0]:
-                try:
-                    if json.loads(line).get("value", 0) > 0:
-                        return _emit_line(line)
-                except ValueError:
-                    pass
-    _mark(f"second-chance run rc={run.returncode} had no good TPU line; keeping cpu line")
-    return False
+        env = dict(os.environ)
+        env["RS_BENCH_NO_FALLBACK"] = "1"
+        env.pop("JAX_PLATFORMS", None)
+        try:
+            run = subprocess.run(
+                [sys.executable, __file__],
+                env=env, capture_output=True, text=True,
+                timeout=child_timeout,
+            )
+        except subprocess.TimeoutExpired:
+            _mark("hardware child timed out; keeping cpu line")
+            return False
+        if run.returncode == 0:
+            for line in run.stdout.splitlines():
+                if line.startswith("{") and "_tpu" in line.split(",")[0]:
+                    try:
+                        if json.loads(line).get("value", 0) > 0:
+                            return _emit_line(line)
+                    except ValueError:
+                        pass
+        tail = run.stderr.strip().splitlines()[-1:] if run.stderr else []
+        _mark(
+            f"hardware child rc={run.returncode} had no good TPU line "
+            f"({tail}); keep probing"
+        )
+        # A fast child failure (tunnel flapped back down before its init)
+        # leaves window — loop; a slow one exhausts it on the next check.
+        # Restore the wedge deadline to the REMAINING base budget: the
+        # child-extended timer would otherwise fire mid-loop under a large
+        # budget and os._exit with the held CPU line, truncating the very
+        # retry window this loop exists to provide.  And back off like the
+        # probe-failure branch — a persistently fast-failing child must
+        # not burn the budget in back-to-back launches.
+        _arm_wedge_watchdog(max(30.0, budget - (_time_mod.time() - _T0)))
+        _time_mod.sleep(15.0)
 
 
 def _verify(small_fn, oracle_slice):
@@ -489,13 +567,13 @@ def main() -> None:
     _PARTIAL = (backend, best, dict(detail))  # refresh: decode keys landed
     # (backend was relabelled "tpu" above whenever the devices are real TPU
     # chips, however the tunnel registers itself — this guard only fires for
-    # genuine CPU fallbacks.  The child never takes a second chance itself.)
+    # genuine CPU fallbacks.  The child never runs its own retry loop.)
     import os as _os
 
     if (
         backend != "tpu"
         and not _os.environ.get("RS_BENCH_NO_FALLBACK")
-        and _second_chance_tpu()
+        and _tpu_retry_until_deadline()
     ):
         return  # the forwarded TPU line is the bench's single output line
     if backend != "tpu":

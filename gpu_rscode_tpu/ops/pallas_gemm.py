@@ -100,6 +100,68 @@ def _expand_nibble(b, w, k, tile):
     return planes.reshape(k * 32, tile)
 
 
+# ---- round-4 probe formulations (VERDICT r3 item 2 / 8) -------------------
+# The 2026-07 Mosaic verdicts pinned the sign/nibble failures to 8-bit iota
+# and int8 arith.subi; every formulation below avoids BOTH (shift amounts
+# are numpy CONSTANTS, no iota op; no int8 subtraction).  All bit-verified
+# in interpret mode; hardware verdicts land in
+# bench_captures/expand_probe_* via tools/expand_probe.py.
+
+
+def _expand_packed32(b, w, k, tile):
+    # VERDICT r3 candidate (b): int32 lane packing.  Bitcast 4 data bytes
+    # into one int32 lane, extract a bit-plane of all 4 with ONE shift-mask
+    # pass (mask 0x01010101), bitcast back to int8 — 4x fewer VPU lane-ops
+    # per plane than per-byte int32 shifts; the MXU sees ordinary int8
+    # planes.  w=8 only (byte-granular packing).  Shift amounts are
+    # python-unrolled scalar immediates (Pallas kernels may not capture
+    # array constants, and vector shift-amounts would need the iota Mosaic
+    # refuses in narrow types).
+    p32 = jax.lax.bitcast_convert_type(
+        b.reshape(k, tile // 4, 4), jnp.int32
+    )  # (k, tile/4)
+    planes32 = jnp.stack(
+        [(p32 >> jnp.int32(s)) & jnp.int32(0x01010101) for s in range(w)],
+        axis=1,
+    )  # (k, w, tile/4)
+    planes8 = jax.lax.bitcast_convert_type(planes32, jnp.int8)
+    return planes8.reshape(k * w, tile)  # (k, w, tile/4, 4) -> rows of bits
+
+
+def _expand_sign16(b, w, k, tile):
+    # VERDICT r3 candidate (d): sign-replication in int16-only lanes (2x
+    # VPU packing vs int32) — bit s to the sign position, arithmetic shift
+    # back to {0, -1}; -1 === 1 (mod 2) so the parity refold is unchanged.
+    # Scalar-immediate shifts, unrolled: no int8 ops, no iota.
+    bts = b.astype(jnp.int16)
+    planes = jnp.stack(
+        [(bts << jnp.int16(15 - s)) >> jnp.int16(15) for s in range(w)],
+        axis=1,
+    )
+    return planes.reshape(k * w, tile)
+
+
+def _expand_shift_u8(b, w, k, tile):
+    # Python-unrolled CONSTANT shifts in uint8 lanes: no iota, no subi,
+    # 4x lane packing vs int32, w compiled-in copies of one shift-mask op.
+    planes = [(b >> np.uint8(s)) & np.uint8(1) for s in range(w)]
+    return jnp.stack(planes, axis=1).reshape(k * w, tile)
+
+
+def _expand_nibble_const(b, w, k, tile):
+    # The nibble one-hot (reference's fastest-kernel idea, gf16.h:1-22)
+    # with the 16 compare values python-unrolled as scalar immediates
+    # instead of the 8-bit iota Mosaic refuses.
+    hi = b >> np.uint8(4)
+    lo = b & np.uint8(0xF)
+    planes = jnp.stack(
+        [hi == np.uint8(v) for v in range(16)]
+        + [lo == np.uint8(v) for v in range(16)],
+        axis=1,
+    )  # (k, 32, tile)
+    return planes.reshape(k * 32, tile)
+
+
 def _kernel(
     a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand, fold
 ):
@@ -108,6 +170,10 @@ def _kernel(
         "sign": _expand_sign,
         "nibble": _expand_nibble,
         "shift": _expand_shift,
+        "packed32": _expand_packed32,
+        "sign16": _expand_sign16,
+        "shift_u8": _expand_shift_u8,
+        "nibble_const": _expand_nibble_const,
     }[expand]
     planes = expander(b_ref[:], w, k, tile)
     acc = jnp.dot(
@@ -148,7 +214,7 @@ def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
     # cpu-rs-double.c:52-55).
     from .gemm import expand_bitmatrix_jnp, expand_nibblematrix_jnp
 
-    if expand == "nibble":
+    if expand in ("nibble", "nibble_const"):
         a_op = expand_nibblematrix_jnp(A, w)
         a_cols = k * 32
     else:
@@ -211,17 +277,18 @@ def gf_matmul_pallas(
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
-    if expand not in ("shift", "sign", "nibble"):
+    _BYTE_ONLY = ("nibble", "nibble_const", "packed32", "sign16", "shift_u8")
+    if expand not in ("shift", "sign") + _BYTE_ONLY:
         raise ValueError(f"unknown expand {expand!r}")
     if expand == "sign" and w not in (8, 16):
         raise ValueError(
             f"expand='sign' needs a lane-width field (w=8 or 16), got w={w}; "
             "use expand='shift' for other widths"
         )
-    if expand == "nibble" and w != 8:
+    if expand in _BYTE_ONLY and w != 8:
         raise ValueError(
-            f"expand='nibble' is a GF(2^8) strategy (two one-hot nibbles per "
-            f"byte), got w={w}"
+            f"expand={expand!r} is a GF(2^8) (byte-granular) strategy, "
+            f"got w={w}"
         )
     A = jnp.asarray(A)
     B = jnp.asarray(B)

@@ -68,7 +68,11 @@ def test_pallas_file_roundtrip(tmp_path):
     assert open(out, "rb").read() == data
 
 
-@pytest.mark.parametrize("expand", ["shift", "sign", "nibble"])
+@pytest.mark.parametrize(
+    "expand",
+    ["shift", "sign", "nibble",
+     "packed32", "sign16", "shift_u8", "nibble_const"],  # r4 probe set
+)
 def test_pallas_expand_modes(expand):
     """All data-expansion formulations are bit-exact (the sign trick's
     {0,-1} planes preserve accumulator parity; the nibble one-hots select
@@ -90,7 +94,11 @@ def test_pallas_nibble_rejects_wide_field():
         gf_matmul_pallas(A, B, w=16, expand="nibble")
 
 
-@pytest.mark.parametrize("expand", ["shift", "sign", "nibble"])
+@pytest.mark.parametrize(
+    "expand",
+    ["shift", "sign", "nibble",
+     "packed32", "sign16", "shift_u8", "nibble_const"],
+)
 def test_pallas_preparity_expand_modes(expand):
     """fold_parity=False (the stripe-sharded pre-psum form) under every
     expansion: folding the raw accumulators must equal the oracle."""
