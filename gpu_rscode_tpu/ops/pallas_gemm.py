@@ -252,7 +252,7 @@ def gf_matmul_pallas(
     tile: int | None = None,
     acc_dtype=None,
     interpret: bool | None = None,
-    expand: str = "shift",
+    expand: str | None = None,
     fold_parity: bool = True,
 ):
     """``C = A . B`` over GF(2^w) via the fused Pallas kernel.
@@ -278,6 +278,29 @@ def gf_matmul_pallas(
     the CPU test mesh.
     """
     _BYTE_ONLY = ("nibble", "nibble_const", "packed32", "sign16", "shift_u8")
+    if expand is None:
+        # Production default, overridable for whole-pipeline hardware
+        # experiments (e.g. RS_PALLAS_EXPAND=packed32 python bench.py)
+        # without touching call sites; the literal default only changes
+        # with a committed capture justifying it.  An env value that is
+        # unknown or inapplicable at this width falls back to shift WITH
+        # a warning — an env typo must neither crash production nor
+        # silently record a capture under the wrong formulation.
+        import os
+
+        expand = os.environ.get("RS_PALLAS_EXPAND") or "shift"
+        applies = expand in ("shift", "sign") + _BYTE_ONLY and (
+            expand == "shift" or w == 8 or (w == 16 and expand == "sign")
+        )
+        if not applies:
+            import warnings
+
+            warnings.warn(
+                f"RS_PALLAS_EXPAND={expand!r} is unknown or does not apply "
+                f"at w={w}; using 'shift'",
+                stacklevel=2,
+            )
+            expand = "shift"
     if expand not in ("shift", "sign") + _BYTE_ONLY:
         raise ValueError(f"unknown expand {expand!r}")
     if expand == "sign" and w not in (8, 16):

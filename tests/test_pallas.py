@@ -137,3 +137,48 @@ def test_pallas_sign_int8_acc(expand):
     B = rng.integers(0, 256, size=(10, 512), dtype=np.uint8)
     got = np.asarray(gf_matmul_pallas(A, B, acc_dtype=jnp.int8, expand=expand))
     np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
+def test_expand_env_default(monkeypatch):
+    """RS_PALLAS_EXPAND overrides the default formulation for whole-pipeline
+    experiments; unknown/inapplicable values warn and fall back to shift,
+    and an explicit expand= argument always wins.  The formulation actually
+    reaching the kernel is spied on — every expansion is bit-identical, so
+    output equality alone cannot prove the env var was honored."""
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    seen = []
+    real = pg._pallas_matmul
+
+    def spy(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
+        seen.append(expand)
+        return real(A, B, w, tile, acc_dtype, interpret, expand, fold)
+
+    monkeypatch.setattr(pg, "_pallas_matmul", spy)
+    rng = np.random.default_rng(3)
+    A = rng.integers(0, 256, size=(2, 4), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+    want = get_field(8).matmul(A, B)
+    monkeypatch.setenv("RS_PALLAS_EXPAND", "packed32")
+    got = np.asarray(gf_matmul_pallas(A, B))  # env default applies (w=8)
+    np.testing.assert_array_equal(got, want)
+    assert seen[-1] == "packed32"
+    # w=16 cannot run a byte-granular strategy: env warns, falls to shift.
+    A16 = rng.integers(0, 1 << 16, size=(2, 4), dtype=np.uint16)
+    B16 = rng.integers(0, 1 << 16, size=(4, 512), dtype=np.uint16)
+    want16 = get_field(16).matmul(A16, B16)
+    with pytest.warns(UserWarning, match="does not apply"):
+        got16 = np.asarray(gf_matmul_pallas(A16, B16, w=16))
+    np.testing.assert_array_equal(got16, want16)
+    assert seen[-1] == "shift"
+    # an env typo warns and falls back instead of crashing production
+    monkeypatch.setenv("RS_PALLAS_EXPAND", "packed_32")
+    with pytest.warns(UserWarning, match="unknown"):
+        got2 = np.asarray(gf_matmul_pallas(A, B))
+    np.testing.assert_array_equal(got2, want)
+    assert seen[-1] == "shift"
+    # explicit argument wins over the env var (no warning, no fallback)
+    monkeypatch.setenv("RS_PALLAS_EXPAND", "nonsense")
+    got3 = np.asarray(gf_matmul_pallas(A, B, expand="sign"))
+    np.testing.assert_array_equal(got3, want)
+    assert seen[-1] == "sign"
