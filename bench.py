@@ -473,6 +473,16 @@ def main() -> None:
         "table": lambda: gf_matmul_jit(Ad, Bd_small, strategy="table"),
     }
     candidates = [("pallas", run_pallas), ("bitplane", run_bitplane), ("table", run_table)]
+    import os
+
+    # Hardware CHILD of the retry loop: it runs under a hard subprocess
+    # timeout against a tunnel that just recovered — every strategy costs
+    # ~30-45 s of remote compiles, and the headline needs only the first
+    # strategy that verifies and times (fastest-first order, so that is
+    # the fused kernel unless it fails; the slower strategies' numbers
+    # exist in committed captures).  The loop breaks after that first
+    # success instead of spending the child's budget on the rest.
+    fast_child = bool(on_tpu and os.environ.get("RS_BENCH_NO_FALLBACK"))
     if not on_tpu and native.available():
         # The threaded C++ host codec (strategy="cpu") is the strongest
         # non-device path (~2.3x the XLA table strategy on this host) — a
@@ -517,6 +527,9 @@ def main() -> None:
                 _PARTIAL = (backend, best, dict(detail))
         except Exception as e:
             detail[name] = f"failed: {type(e).__name__}"
+        if fast_child and best[0] is not None:
+            _mark("hardware child: headline strategy landed; skipping the rest")
+            break
     _mark(f"strategies done: {detail}")
 
     if best[0] is None:

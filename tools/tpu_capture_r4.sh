@@ -27,6 +27,9 @@ capture() {  # capture <name> <timeout> <cmd...>
   timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
   local rc=$?
   echo "# ${name} rc=${rc}" >&2
+  # commented-jsonl convention: '#'-prefix any human-readable lines a tool
+  # printed to stdout (e.g. stream_bench phase summaries)
+  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
   if [ -s "$out" ]; then
     git add "$out" "${out%.jsonl}.log" 2>/dev/null
     git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
