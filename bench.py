@@ -581,11 +581,9 @@ def main() -> None:
     # (backend was relabelled "tpu" above whenever the devices are real TPU
     # chips, however the tunnel registers itself — this guard only fires for
     # genuine CPU fallbacks.  The child never runs its own retry loop.)
-    import os as _os
-
     if (
         backend != "tpu"
-        and not _os.environ.get("RS_BENCH_NO_FALLBACK")
+        and not os.environ.get("RS_BENCH_NO_FALLBACK")
         and _tpu_retry_until_deadline()
     ):
         return  # the forwarded TPU line is the bench's single output line

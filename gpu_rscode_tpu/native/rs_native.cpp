@@ -83,7 +83,10 @@ void gemm_range_scalar(const uint8_t* A, const uint8_t* B, uint8_t* C, int p,
 // ~10x the 64 KiB-table scalar loop per core: the scalar path is one
 // dependent L1 gather per byte; this is 2 shuffles + 3 xors per 32 bytes.
 // Parity rows are processed in groups of 4 sharing each loaded data block,
-// so B streams from DRAM once per group instead of once per parity row.
+// so B streams from DRAM once per group instead of once per parity row,
+// and the column loop runs 2x32-byte blocks per iteration so each pair of
+// nibble tables is loaded from L1 once per 64 output bytes (the table
+// loads, not the shuffles, bound the 1-block form).
 void gemm_range_avx2(const uint8_t* A, const uint8_t* B, uint8_t* C, int p,
                      int k, long long m, long long lo, long long hi) {
   const __m256i nib = _mm256_set1_epi8(0x0f);
@@ -109,6 +112,42 @@ void gemm_range_avx2(const uint8_t* A, const uint8_t* B, uint8_t* C, int p,
       }
     }
     long long c = lo;
+    for (; c + 64 <= hi; c += 64) {
+      __m256i acc[kGroup], acc2[kGroup];
+      for (int g = 0; g < kGroup; ++g) {
+        acc[g] = _mm256_setzero_si256();
+        acc2[g] = _mm256_setzero_si256();
+      }
+      for (int t = 0; t < k; ++t) {
+        const uint8_t* brow = B + static_cast<long long>(t) * m;
+        const __m256i v = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(brow + c));
+        const __m256i w2 = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(brow + c + 32));
+        const __m256i vl = _mm256_and_si256(v, nib);
+        const __m256i vh = _mm256_and_si256(_mm256_srli_epi16(v, 4), nib);
+        const __m256i wl = _mm256_and_si256(w2, nib);
+        const __m256i wh = _mm256_and_si256(_mm256_srli_epi16(w2, 4), nib);
+        for (int g = 0; g < pg; ++g) {
+          const __m256i lo_tab = tlo[g * k + t];
+          const __m256i hi_tab = thi[g * k + t];
+          acc[g] = _mm256_xor_si256(
+              acc[g],
+              _mm256_xor_si256(_mm256_shuffle_epi8(lo_tab, vl),
+                               _mm256_shuffle_epi8(hi_tab, vh)));
+          acc2[g] = _mm256_xor_si256(
+              acc2[g],
+              _mm256_xor_si256(_mm256_shuffle_epi8(lo_tab, wl),
+                               _mm256_shuffle_epi8(hi_tab, wh)));
+        }
+      }
+      for (int g = 0; g < pg; ++g) {
+        uint8_t* crow = C + static_cast<long long>(i0 + g) * m;
+        _mm256_storeu_si256(reinterpret_cast<__m256i*>(crow + c), acc[g]);
+        _mm256_storeu_si256(reinterpret_cast<__m256i*>(crow + c + 32),
+                            acc2[g]);
+      }
+    }
     for (; c + 32 <= hi; c += 32) {
       __m256i acc[kGroup] = {_mm256_setzero_si256(), _mm256_setzero_si256(),
                              _mm256_setzero_si256(), _mm256_setzero_si256()};
