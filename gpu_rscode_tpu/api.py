@@ -386,14 +386,20 @@ def _encode_file_multiprocess(
 
     The reference tops out at one machine (pthread-per-GPU, SURVEY §2);
     this is the genuinely-distributed extension: every participating host
-    stages only ITS column range of each segment (the byte ranges its mesh
+    stages only ITS portion of each segment (the byte ranges its mesh
     devices own), the global array is assembled with
     ``make_array_from_process_local_data`` (put_sharded's multi-process
     branch), the sharded GEMM runs collectively, and each host writes only
     its addressable output shards into the shared-filesystem chunk files.
-    Requirements: a shared filesystem and cols-only sharding (w=8 and the
-    w=16 wide-symbol extension both work; device columns are whole
-    symbols, so w=16 byte offsets are 2x the sharding's symbol spans).
+    Requirements: a shared filesystem; w=8 and the w=16 wide-symbol
+    extension both work (device columns are whole symbols, so w=16 byte
+    offsets are 2x the sharding's symbol spans).
+
+    ``stripe_sharded`` composes with multi-process: the k axis shards
+    across the mesh too (each host stages only its stripe rows — the
+    wide-stripe DCN layout of BASELINE config 4), the psum rides the
+    process boundary, and hosts on stripe row 0 write the (replicated)
+    parity output.
 
     All processes must call encode_file with the same arguments (it is a
     collective).  The lead process (lowest process index in the mesh)
@@ -405,23 +411,31 @@ def _encode_file_multiprocess(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from . import native
-    from .parallel.mesh import COLS
+    from .parallel.mesh import COLS, STRIPE
     from .parallel.sharded import put_sharded, sharded_gf_matmul
 
     mesh = codec.mesh
     k, p = codec.native_num, codec.parity_num
     sym = codec.w // 8
-    if codec.stripe_sharded:
-        raise NotImplementedError(
-            "multi-process file encode shards the cols axis only "
-            "(stripe_sharded=True is a single-process mesh feature)"
-        )
+    stripe_sharded = codec.stripe_sharded
 
     lead = jax.process_index() == min(
         d.process_index for d in mesh.devices.flat
     )
     cols_size = mesh.shape[COLS]
+    # Input sharding: wide-stripe mode also shards the k axis — each host
+    # stages only the stripe rows its devices own (its share of the file),
+    # the DCN-scale layout BASELINE config 4 describes.  The GEMM's output
+    # is replicated along stripe (psum), so only hosts on stripe row 0
+    # write parity (identical replicas elsewhere — writing them would just
+    # duplicate shared-FS IO).
+    in_sharding = NamedSharding(
+        mesh, P(STRIPE if stripe_sharded else None, COLS)
+    )
     sharding = NamedSharding(mesh, P(None, COLS))
+    writes_parity = not stripe_sharded or jax.process_index() in {
+        d.process_index for d in mesh.devices[0].flat
+    }
 
     written: list[str] = [
         chunk_file_name(file_name, i) for i in range(k + p)
@@ -454,12 +468,27 @@ def _encode_file_multiprocess(
             # zero and is trimmed at write time.
             cols_s = cols // sym
             W = ((cols_s + cols_size - 1) // cols_size) * cols_size
-            lo, hi = _local_col_span(sharding, k, W)
+            if not stripe_sharded:
+                lo, hi = _local_col_span(sharding, k, W)
+                with timer.phase("stage segment (io)"):
+                    seg = native.stripe_read(
+                        file_name, chunk, k, off + lo * sym, (hi - lo) * sym,
+                        total_size, fallback_src=src,
+                    )
+                    return seg.view(np.uint16) if sym == 2 else seg
+            # Wide stripe: this host stages only its (stripe rows x column
+            # span) block — its own share of the file's byte ranges.
+            r0, r1, lo, hi = _local_block(in_sharding, (k, W))
             with timer.phase("stage segment (io)"):
-                seg = native.stripe_read(
-                    file_name, chunk, k, off + lo * sym, (hi - lo) * sym,
-                    total_size, fallback_src=src,
-                )
+                seg = np.zeros((r1 - r0, (hi - lo) * sym), dtype=np.uint8)
+                for i in range(r0, r1):
+                    b0 = i * chunk + off + lo * sym
+                    b1 = min(
+                        b0 + (hi - lo) * sym, (i + 1) * chunk, total_size
+                    )
+                    n = max(0, b1 - b0)
+                    if n:
+                        seg[i - r0, :n] = src[b0 : b0 + n]
                 return seg.view(np.uint16) if sym == 2 else seg
 
         parity_fps = [open(tmps[name], "r+b") for name in parity_names]
@@ -467,6 +496,13 @@ def _encode_file_multiprocess(
 
             def drain(tag, parity_sharded) -> None:
                 off, cols = tag
+                if not writes_parity:
+                    # Replica holder (stripe rows >= 1): row 0 writes the
+                    # identical bytes.  Block for window backpressure only
+                    # — no device-to-host copy of parity this host drops.
+                    with timer.phase("encode compute"):
+                        jax.block_until_ready(parity_sharded)
+                    return
                 with timer.phase("encode compute"):
                     shards = _trimmed_shards(parity_sharded, cols, sym)
                 with timer.phase("write parity (io)"):
@@ -483,11 +519,11 @@ def _encode_file_multiprocess(
             ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
                 for (off, cols), local_seg in prefetch:
                     with timer.phase("encode dispatch"):
-                        Bd = put_sharded(local_seg, mesh, False)
+                        Bd = put_sharded(local_seg, mesh, stripe_sharded)
                         parity = sharded_gf_matmul(
                             np.asarray(codec.parity_block), Bd,
                             mesh=mesh, w=codec.w, strategy=codec.strategy,
-                            stripe_sharded=False,
+                            stripe_sharded=stripe_sharded,
                         )
                     window.push((off, cols), parity)
         finally:
@@ -751,6 +787,34 @@ def _local_col_span(sharding, k: int, W: int) -> tuple[int, int]:
     return lo, hi
 
 
+def _local_block(sharding, shape) -> tuple[int, int, int, int]:
+    """This process's contiguous (row, col) block of a 2-D sharded global
+    array — the staging layout of the wide-stripe (row-sharded) encode
+    collective, generalising :func:`_local_col_span` to both axes.
+
+    Returns ``(r0, r1, c0, c1)``.  Each axis must tile contiguously and the
+    process's shards must form the full cartesian block (meshes built from
+    ``jax.devices()`` order do)."""
+    idx = sharding.addressable_devices_indices_map(shape)
+
+    def axis_span(a: int) -> tuple[int, int]:
+        spans = sorted({
+            (s[a].start or 0,
+             shape[a] if s[a].stop is None else s[a].stop)
+            for s in idx.values()
+        })
+        if any(x[1] != y[0] for x, y in zip(spans, spans[1:])):
+            raise ValueError(
+                f"mesh axis {a} gives this process a non-contiguous range; "
+                "build the mesh from jax.devices() order"
+            )
+        return spans[0][0], spans[-1][1]
+
+    r0, r1 = axis_span(0)
+    c0, c1 = axis_span(1)
+    return r0, r1, c0, c1
+
+
 def _make_padded_stage(fps, maps, chunk, cols_size, sharding, k, timer, sym=1):
     """Segment stager shared by the multi-process decode and repair
     collectives: reads this process's column span of the k survivor files,
@@ -786,9 +850,15 @@ def _trimmed_shards(sharded, cols: int, sym: int = 1):
     phase.  ``sym``-byte symbols are flattened to little-endian bytes, the
     chunk-file byte order."""
     out = []
+    seen: set = set()
     cols_s = cols // sym
     for sh in sharded.addressable_shards:
-        col0 = sh.index[1].start
+        col0 = sh.index[1].start or 0  # None for an unsharded cols axis
+        if col0 in seen:
+            # stripe-replicated output: every stripe row holds an identical
+            # replica of each column shard — materialise one per range.
+            continue
+        seen.add(col0)
         data = np.asarray(sh.data)
         n_cols = min(data.shape[1], cols_s - col0)
         if n_cols <= 0:

@@ -260,6 +260,31 @@ def main() -> None:
         assert "_2_" not in auto_conf, f"corrupt chunk kept: {auto_conf}"
     multihost_utils.sync_global_devices("auto_checked")
 
+    # --- wide-stripe multi-process encode: the k axis shards ACROSS the
+    # two hosts (each stages only its own stripe rows of the file), the
+    # bit-plane psum rides the process boundary, and only stripe-row-0's
+    # host writes the replicated parity — archive must be byte-identical
+    # to the single-process golden encode ----------------------------------
+    wsdir = os.path.join(workdir, "widestripe")
+    wspath = os.path.join(wsdir, "payload.bin")
+    if pid == 0:
+        os.makedirs(wsdir, exist_ok=True)
+        with open(wspath, "wb") as fp:
+            fp.write(payload)
+    multihost_utils.sync_global_devices("ws_setup")
+    api.encode_file(
+        wspath, kf, pf, mesh=mesh2, stripe_sharded=True, checksums=True,
+        segment_bytes=128 * 1024,
+    )
+    if pid == 0:
+        for i in range(kf + pf):
+            a = open(chunk_file_name(wspath, i), "rb").read()
+            b = open(chunk_file_name(gpath, i), "rb").read()
+            assert a == b, f"wide-stripe chunk {i} differs from golden"
+        assert (open(wspath + ".METADATA").read()
+                == open(gpath + ".METADATA").read()), "ws metadata differs"
+    multihost_utils.sync_global_devices("ws_checked")
+
     # --- lead-error lockstep, auto-decode: an UNRECOVERABLE archive (fewer
     # than k healthy chunks) fails only in the lead's scan/selection; the
     # ok/error broadcast must turn that into an exception on EVERY process

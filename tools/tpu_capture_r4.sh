@@ -1,5 +1,5 @@
 #!/bin/bash
-# Round-4 TPU capture orchestrator.  Probes the axon tunnel every ~2 min;
+# Round-4 TPU capture orchestrator.  Probes the axon tunnel every ~1-2 min;
 # on the first healthy probe it captures the round-4 evidence set in
 # priority order, git-committing after EVERY capture (the tunnel can wedge
 # mid-run at any point — r3 memory: capture the moment a probe succeeds,
@@ -42,7 +42,7 @@ capture() {  # capture <name> <timeout> <cmd...>
 while [ $((SECONDS - START)) -lt "$MAX" ]; do
   ATTEMPT=$((ATTEMPT + 1))
   echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
-  if timeout 90 python - <<'EOF' >/dev/null 2>&1
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
 import sys
 import jax
 sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
@@ -76,7 +76,7 @@ EOF
     echo "# round-4 capture set complete" >&2
     exit 0
   fi
-  sleep 120
+  sleep 45
 done
 echo "# deadline reached without healthy tunnel" >&2
 exit 2
