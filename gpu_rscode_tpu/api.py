@@ -411,7 +411,7 @@ def _encode_file_multiprocess(
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from . import native
-    from .parallel.mesh import COLS, STRIPE
+    from .parallel.mesh import COLS
     from .parallel.sharded import put_sharded, sharded_gf_matmul
 
     mesh = codec.mesh
@@ -426,16 +426,11 @@ def _encode_file_multiprocess(
     # Input sharding: wide-stripe mode also shards the k axis — each host
     # stages only the stripe rows its devices own (its share of the file),
     # the DCN-scale layout BASELINE config 4 describes.  The GEMM's output
-    # is replicated along stripe (psum), so only hosts on stripe row 0
-    # write parity (identical replicas elsewhere — writing them would just
+    # is replicated along stripe (psum), so only stripe-row-0 hosts write
+    # parity (identical replicas elsewhere — writing them would just
     # duplicate shared-FS IO).
-    in_sharding = NamedSharding(
-        mesh, P(STRIPE if stripe_sharded else None, COLS)
-    )
+    in_sharding, writes_parity = _stripe_io_roles(mesh, stripe_sharded)
     sharding = NamedSharding(mesh, P(None, COLS))
-    writes_parity = not stripe_sharded or jax.process_index() in {
-        d.process_index for d in mesh.devices[0].flat
-    }
 
     written: list[str] = [
         chunk_file_name(file_name, i) for i in range(k + p)
@@ -592,18 +587,13 @@ def decode_file(
     """
     timer = timer or PhaseTimer(enabled=False)
     if len(_mesh_processes(mesh)) > 1:
-        # Checked before any archive IO (the checksum pre-pass below reads
-        # every chunk): the multi-process path does its own lead-verified
+        # The multi-process path does its own lead-verified checksum
         # pre-pass and collective recovery.
-        if stripe_sharded:
-            raise NotImplementedError(
-                "multi-process file decode shards the cols axis only "
-                "(stripe_sharded=True is a single-process mesh feature)"
-            )
         return _decode_file_multiprocess(
             in_file, conf_file, output,
             strategy=strategy, segment_bytes=segment_bytes,
             pipeline_depth=pipeline_depth, mesh=mesh,
+            stripe_sharded=stripe_sharded,
             verify_checksums=verify_checksums, timer=timer,
         )
     with timer.phase("read metadata (io)"):
@@ -815,27 +805,59 @@ def _local_block(sharding, shape) -> tuple[int, int, int, int]:
     return r0, r1, c0, c1
 
 
+def _stripe_io_roles(mesh, stripe_sharded: bool):
+    """Input sharding and write role for the wide-stripe collectives.
+
+    Returns ``(in_sharding, writes_output)``: the data sharding
+    (``P(STRIPE, COLS)`` under wide-stripe, ``P(None, COLS)`` otherwise)
+    and whether THIS process writes the GEMM output.  Under stripe
+    sharding the output is psum-replicated along the stripe axis, so only
+    hosts whose devices sit on stripe index 0 write (located by axis NAME
+    — a mesh built with transposed axis order still elects a writer set
+    that covers every column shard).  Shared by the encode, decode and
+    repair collectives so the election rule cannot drift between them.
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from .parallel.mesh import COLS, STRIPE
+
+    in_sharding = NamedSharding(
+        mesh, P(STRIPE if stripe_sharded else None, COLS)
+    )
+    if not stripe_sharded:
+        return in_sharding, True
+    ax = list(mesh.axis_names).index(STRIPE)
+    row0 = np.take(np.asarray(mesh.devices), 0, axis=ax)
+    writes = jax.process_index() in {
+        d.process_index for d in row0.flat
+    }
+    return in_sharding, writes
+
+
 def _make_padded_stage(fps, maps, chunk, cols_size, sharding, k, timer, sym=1):
     """Segment stager shared by the multi-process decode and repair
-    collectives: reads this process's column span of the k survivor files,
-    zero-filling the pad columns past the chunk end (equal per-device
-    shards need the padded width; the pad's decoded garbage is dropped by
-    the trimmed writes).  Sharding spans are in SYMBOL units (``sym``
-    bytes each — 2 for w=16, whose segments come back as uint16 views);
-    the file reads convert back to byte offsets."""
+    collectives: reads this process's block of the k survivor files —
+    its column span, and (when ``sharding`` also shards the stripe/k axis,
+    the wide-stripe mode) only its survivor rows — zero-filling the pad
+    columns past the chunk end (equal per-device shards need the padded
+    width; the pad's decoded garbage is dropped by the trimmed writes).
+    Sharding spans are in SYMBOL units (``sym`` bytes each — 2 for w=16,
+    whose segments come back as uint16 views); the file reads convert
+    back to byte offsets."""
     from . import native
 
     def stage(off: int, cols: int):
         off_s, cols_s, chunk_s = off // sym, cols // sym, chunk // sym
         W = ((cols_s + cols_size - 1) // cols_size) * cols_size
-        lo, hi = _local_col_span(sharding, k, W)
+        r0, r1, lo, hi = _local_block(sharding, (k, W))
         readable = max(0, min(off_s + hi, chunk_s) - (off_s + lo))
         with timer.phase("stage segment (io)"):
-            seg = np.zeros((k, (hi - lo) * sym), dtype=np.uint8)
+            seg = np.zeros((r1 - r0, (hi - lo) * sym), dtype=np.uint8)
             if readable:
                 seg[:, : readable * sym] = native.gather_rows(
-                    fps, (off_s + lo) * sym, readable * sym,
-                    fallback_maps=maps,
+                    fps[r0:r1], (off_s + lo) * sym, readable * sym,
+                    fallback_maps=maps[r0:r1],
                 )
             return seg.view(np.uint16) if sym == 2 else seg
 
@@ -891,12 +913,16 @@ def _decode_file_multiprocess(
     segment_bytes: int,
     pipeline_depth: int,
     mesh,
+    stripe_sharded: bool = False,
     verify_checksums: bool | None,
     timer: PhaseTimer,
 ) -> str:
     """Multi-host file decode over a process-spanning mesh (collective).
 
-    Mirrors :func:`_encode_file_multiprocess`: every host stages only its
+    Mirrors :func:`_encode_file_multiprocess` (including its wide-stripe
+    composition: ``stripe_sharded`` shards the SURVIVOR axis across hosts,
+    each staging only its survivor rows, with stripe-row-0 hosts writing
+    the psum-replicated recovery): every host stages only its
     column span of each survivor segment, the recovery GEMM runs sharded
     over the mesh, and each host pwrites its addressable output shards into
     a shared-filesystem temp the lead process pre-sizes and atomically
@@ -905,12 +931,11 @@ def _decode_file_multiprocess(
     device).  The checksum pre-pass runs on the lead only and its verdict
     is broadcast, so a corrupt survivor raises the same
     :class:`ChunkIntegrityError` on every process instead of wedging peers
-    at a barrier.  Requirements: shared filesystem and cols-only sharding,
-    w=8 or w=16 (same contract as multi-process encode).
+    at a barrier.  Requirements: shared filesystem, w=8 or w=16 (same
+    contract as multi-process encode).
     """
     import jax
     from jax.experimental import multihost_utils
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .parallel.mesh import COLS
     from .parallel.sharded import put_sharded, sharded_gf_matmul
@@ -999,7 +1024,10 @@ def _decode_file_multiprocess(
         multihost_utils.sync_global_devices("rs_decode_promoted")
         return out_path
 
-    codec = RSCodec(k, p, w=w, strategy=strategy, mesh=mesh)
+    codec = RSCodec(
+        k, p, w=w, strategy=strategy, mesh=mesh,
+        stripe_sharded=stripe_sharded,
+    )
     total_mat = total_mat.astype(codec.gf.dtype)
     with timer.phase("invert matrix"):
         dec_mat = codec.decode_matrix_from(total_mat, rows)
@@ -1016,7 +1044,10 @@ def _decode_file_multiprocess(
     tmp_path = out_path + ".rs_tmp"
     seg_cols = _segment_cols(chunk, k, segment_bytes)
     cols_size = mesh.shape[COLS]
-    sharding = NamedSharding(mesh, P(None, COLS))
+    # Wide-stripe mode: the SURVIVOR axis shards across hosts too — each
+    # stages only its survivor rows; the recovered output is replicated
+    # along stripe (psum), so only stripe-row-0 hosts write it.
+    in_sharding, writes_output = _stripe_io_roles(mesh, stripe_sharded)
     copy_step = max(1, segment_bytes)
 
     try:
@@ -1052,11 +1083,17 @@ def _decode_file_multiprocess(
 
             if dec_missing is not None:
                 stage = _make_padded_stage(
-                    fps, maps, chunk, cols_size, sharding, k, timer, sym
+                    fps, maps, chunk, cols_size, in_sharding, k, timer, sym
                 )
 
                 def drain(tag, rec_sharded) -> None:
                     off, cols = tag
+                    if not writes_output:
+                        # Replica holder: block for window backpressure
+                        # only (stripe row 0 writes the identical bytes).
+                        with timer.phase("decode compute"):
+                            jax.block_until_ready(rec_sharded)
+                        return
                     with timer.phase("decode compute"):
                         shards = _trimmed_shards(rec_sharded, cols, sym)
                     with timer.phase("write output (io)"):
@@ -1070,11 +1107,11 @@ def _decode_file_multiprocess(
                 ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
                     for (off, cols), local_seg in prefetch:
                         with timer.phase("decode dispatch"):
-                            Bd = put_sharded(local_seg, mesh, False)
+                            Bd = put_sharded(local_seg, mesh, stripe_sharded)
                             rec = sharded_gf_matmul(
                                 np.asarray(dec_missing), Bd,
                                 mesh=mesh, w=w, strategy=codec.strategy,
-                                stripe_sharded=False,
+                                stripe_sharded=stripe_sharded,
                             )
                         window.push((off, cols), rec)
         finally:
@@ -1311,14 +1348,10 @@ def repair_file(
     """
     timer = timer or PhaseTimer(enabled=False)
     if len(_mesh_processes(mesh)) > 1:
-        if stripe_sharded:
-            raise NotImplementedError(
-                "multi-process repair shards the cols axis only "
-                "(stripe_sharded=True is a single-process mesh feature)"
-            )
         return _repair_file_multiprocess(
             in_file, strategy=strategy, segment_bytes=segment_bytes,
-            pipeline_depth=pipeline_depth, mesh=mesh, timer=timer,
+            pipeline_depth=pipeline_depth, mesh=mesh,
+            stripe_sharded=stripe_sharded, timer=timer,
         )
     with timer.phase("scan chunks (io)"):
         scan = _scan_chunks(in_file, segment_bytes)
@@ -1454,6 +1487,7 @@ def _repair_file_multiprocess(
     segment_bytes: int,
     pipeline_depth: int,
     mesh,
+    stripe_sharded: bool = False,
     timer: PhaseTimer,
 ) -> list[int]:
     """Multi-host archive repair over a process-spanning mesh (collective).
@@ -1463,14 +1497,15 @@ def _repair_file_multiprocess(
     broadcasts the per-chunk state, so every process derives the same
     survivor subset and rebuild matrix deterministically.  The rebuild GEMM
     then streams exactly like multi-process encode: each host stages its
-    column span of the survivors, and pwrites its addressable shards of
-    every rebuilt chunk into lead-pre-sized shared-filesystem temps that
-    the lead atomically promotes.  Requirements: shared filesystem and
-    cols-only sharding, w=8 or w=16.
+    block of the survivors (column span; survivor-row span too under
+    ``stripe_sharded``, the wide-stripe composition), and pwrites its
+    addressable shards of every rebuilt chunk into lead-pre-sized
+    shared-filesystem temps that the lead atomically promotes — under
+    stripe sharding the rebuilt output is psum-replicated, so stripe-row-0
+    hosts write it.  Requirements: shared filesystem, w=8 or w=16.
     """
     import jax
     from jax.experimental import multihost_utils
-    from jax.sharding import NamedSharding, PartitionSpec as P
 
     from .ops.gf import get_field
     from .parallel.mesh import COLS
@@ -1546,10 +1581,13 @@ def _repair_file_multiprocess(
         mat = total_mat.astype(gf.dtype)
         rebuild_mat = gf.matmul(mat[targets], inv)  # (targets, k)
 
-    codec = RSCodec(k, p, w=w, strategy=strategy, mesh=mesh)
+    codec = RSCodec(
+        k, p, w=w, strategy=strategy, mesh=mesh,
+        stripe_sharded=stripe_sharded,
+    )
     seg_cols = _segment_cols(chunk, k, segment_bytes)
     cols_size = mesh.shape[COLS]
-    sharding = NamedSharding(mesh, P(None, COLS))
+    in_sharding, writes_output = _stripe_io_roles(mesh, stripe_sharded)
     tmp_paths = {t: chunk_file_name(in_file, t) + ".rs_tmp" for t in targets}
     new_crcs: dict[int, int] = {}
 
@@ -1570,11 +1608,16 @@ def _repair_file_multiprocess(
         out_fps = {t: open(tmp_paths[t], "r+b") for t in targets}
         try:
             stage = _make_padded_stage(
-                surv_fps, surv_maps, chunk, cols_size, sharding, k, timer, sym
+                surv_fps, surv_maps, chunk, cols_size, in_sharding, k,
+                timer, sym,
             )
 
             def drain(tag, rebuilt_sharded) -> None:
                 off, cols = tag
+                if not writes_output:
+                    with timer.phase("repair compute"):
+                        jax.block_until_ready(rebuilt_sharded)
+                    return
                 with timer.phase("repair compute"):
                     shards = _trimmed_shards(rebuilt_sharded, cols, sym)
                 with timer.phase("write chunks (io)"):
@@ -1591,11 +1634,11 @@ def _repair_file_multiprocess(
             ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
                 for (off, cols), local_seg in prefetch:
                     with timer.phase("repair dispatch"):
-                        Bd = put_sharded(local_seg, mesh, False)
+                        Bd = put_sharded(local_seg, mesh, stripe_sharded)
                         rebuilt = sharded_gf_matmul(
                             np.asarray(rebuild_mat), Bd,
                             mesh=mesh, w=w, strategy=codec.strategy,
-                            stripe_sharded=False,
+                            stripe_sharded=stripe_sharded,
                         )
                     window.push((off, cols), rebuilt)
         finally:

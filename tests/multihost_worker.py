@@ -285,6 +285,38 @@ def main() -> None:
                 == open(gpath + ".METADATA").read()), "ws metadata differs"
     multihost_utils.sync_global_devices("ws_checked")
 
+    # --- wide-stripe decode + repair: survivor axis sharded across hosts
+    # (each stages only its survivor rows), recovery psum crosses the
+    # process boundary, stripe-row-0 host writes the output ---------------
+    ws_conf = os.path.join(wsdir, "ws.conf")
+    if pid == 0:
+        write_conf(ws_conf, [
+            os.path.basename(chunk_file_name(wspath, i))
+            for i in range(pf, pf + kf)
+        ])
+        for i in range(pf):
+            os.remove(chunk_file_name(wspath, i))
+    multihost_utils.sync_global_devices("ws_decode_setup")
+    out_ws = os.path.join(workdir, "recovered_ws.bin")
+    api.decode_file(
+        wspath, ws_conf, out_ws, mesh=mesh2, stripe_sharded=True,
+        segment_bytes=128 * 1024,
+    )
+    if pid == 0:
+        assert open(out_ws, "rb").read() == payload, "ws decode differs"
+    multihost_utils.sync_global_devices("ws_decode_checked")
+
+    rebuilt = api.repair_file(
+        wspath, mesh=mesh2, stripe_sharded=True, segment_bytes=128 * 1024
+    )
+    assert sorted(rebuilt) == [0, 1], rebuilt
+    if pid == 0:
+        for i in range(kf + pf):
+            a = open(chunk_file_name(wspath, i), "rb").read()
+            b = open(chunk_file_name(gpath, i), "rb").read()
+            assert a == b, f"ws repaired chunk {i} differs from golden"
+    multihost_utils.sync_global_devices("ws_repair_checked")
+
     # --- lead-error lockstep, auto-decode: an UNRECOVERABLE archive (fewer
     # than k healthy chunks) fails only in the lead's scan/selection; the
     # ok/error broadcast must turn that into an exception on EVERY process
