@@ -80,6 +80,22 @@ def _expand_shift(b, w, k, tile):
     return ((b[:, None, :] >> in_shifts) & 1).reshape(k * w, tile)
 
 
+def _expand_shift_raw(b, w, k, tile):
+    # ``shift`` without the ``& 1`` — the round-4 algebraic shortcut.  The
+    # matmul's accumulator is only ever read modulo 2 (XOR == parity), and
+    # (b >> s) === bit_s (mod 2): every higher bit of the unmasked plane
+    # contributes an even term (2^(t-s) for t > s), invisible to parity.
+    # The int8 MXU cast wraps plane values mod 256 (even — parity-safe, and
+    # two's-complement v-256 === v mod 2), products are exact in int32
+    # (|sum| <= k*w*128 << 2^31), and the f32 path is exact below 2^24.
+    # Net effect: w fewer VPU mask ops per input byte on the kernel's
+    # bottleneck (the r3 floors capture pinned the kernel compute-bound on
+    # expansion at ~65 of 286 GB/s DMA floor).
+    b = b.astype(jnp.int32)
+    in_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    return (b[:, None, :] >> in_shifts).reshape(k * w, tile)
+
+
 def _expand_sign(b, w, k, tile):
     sdt = jnp.int8 if w == 8 else jnp.int16
     bts = jax.lax.bitcast_convert_type(b, sdt)
@@ -170,6 +186,7 @@ def _kernel(
         "sign": _expand_sign,
         "nibble": _expand_nibble,
         "shift": _expand_shift,
+        "shift_raw": _expand_shift_raw,
         "packed32": _expand_packed32,
         "sign16": _expand_sign16,
         "shift_u8": _expand_shift_u8,
@@ -269,15 +286,21 @@ def gf_matmul_pallas(
     the measured-best per backend (committed v5e capture
     bench_captures/tile_pick_tpu_20260730T050344Z.jsonl: int8 @ tile 16384 =
     64.3 GB/s).
-    ``expand``: data-expansion formulation — "shift" (default), "sign", or
-    "nibble" (w=8 only: one-hot nibble planes against the (p*w, k*32)
+    ``expand``: data-expansion formulation — "shift" (default) or
+    "shift_raw" (any width; w=16 needs acc_dtype=int8 — unmasked planes
+    exceed bf16's exact-integer range), "sign" (w=8/16), or the
+    byte-granular set "nibble"/"nibble_const"/"packed32"/"sign16"/
+    "shift_u8" (w=8 only; the nibble pair one-hots against the (p*w, k*32)
     operator; see module docstring).  On the current TPU toolchain only
-    "shift" lowers to hardware — "sign"/"nibble" fail Mosaic legalization
-    (see the module docstring's hardware verdict) and serve interpret mode.
+    "shift"/"shift_raw" lower to hardware — the rest fail Mosaic
+    legalization (see the module docstring's hardware verdict and
+    bench_captures/expand_probe_*) and serve interpret mode.
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
     _BYTE_ONLY = ("nibble", "nibble_const", "packed32", "sign16", "shift_u8")
+    _ANY_W = ("shift", "shift_raw")
+    from_env = False
     if expand is None:
         # Production default, overridable for whole-pipeline hardware
         # experiments (e.g. RS_PALLAS_EXPAND=packed32 python bench.py)
@@ -289,8 +312,9 @@ def gf_matmul_pallas(
         import os
 
         expand = os.environ.get("RS_PALLAS_EXPAND") or "shift"
-        applies = expand in ("shift", "sign") + _BYTE_ONLY and (
-            expand == "shift" or w == 8 or (w == 16 and expand == "sign")
+        from_env = expand != "shift"
+        applies = expand in _ANY_W + ("sign",) + _BYTE_ONLY and (
+            expand in _ANY_W or w == 8 or (w == 16 and expand == "sign")
         )
         if not applies:
             import warnings
@@ -301,7 +325,7 @@ def gf_matmul_pallas(
                 stacklevel=2,
             )
             expand = "shift"
-    if expand not in ("shift", "sign") + _BYTE_ONLY:
+    if expand not in _ANY_W + ("sign",) + _BYTE_ONLY:
         raise ValueError(f"unknown expand {expand!r}")
     if expand == "sign" and w not in (8, 16):
         raise ValueError(
@@ -325,6 +349,25 @@ def gf_matmul_pallas(
         tile = DEFAULT_TILE if interpret else TPU_TILE
     if acc_dtype is None:
         acc_dtype = jnp.bfloat16 if interpret else jnp.int8
+    if expand == "shift_raw" and w == 16 and acc_dtype != jnp.int8:
+        # Unmasked 16-bit planes reach 65535; bf16 represents integers
+        # exactly only up to 2^8, so rounding would corrupt the parity.
+        # (int8 wraps mod 256 — even, parity-safe; w<=8 planes are <=255
+        # and exact in bf16.)  Env-selected modes keep the warn-and-fall-
+        # back guarantee instead of crashing production.
+        if from_env:
+            import warnings
+
+            warnings.warn(
+                "RS_PALLAS_EXPAND=shift_raw needs acc_dtype=int8 at w=16; "
+                "using 'shift'",
+                stacklevel=2,
+            )
+            expand = "shift"
+        else:
+            raise ValueError(
+                "expand='shift_raw' at w=16 requires acc_dtype=int8"
+            )
     return _pallas_matmul(
         A, B, w, tile, acc_dtype, interpret, expand, fold=fold_parity
     )

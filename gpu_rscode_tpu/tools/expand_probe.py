@@ -12,6 +12,10 @@ Round-4 candidates (all avoid the ops Mosaic refused in r3 — 8-bit iota,
 int8 subi; see ops/pallas_gemm.py):
 
 * ``shift``        — production baseline (int32 lanes, iota shifts).
+* ``shift_raw``    — shift WITHOUT the ``& 1``: (b >> s) === bit_s (mod 2)
+                     and the accumulator is only read modulo 2, so the
+                     mask is algebraically redundant — w fewer VPU ops
+                     per input byte on the proven-lowerable path.
 * ``packed32``     — 4 bytes per int32 lane, one shift-mask per plane,
                      bitcast back to int8 (candidate b).
 * ``sign16``       — {0,-1} sign-replication in int16-only lanes
@@ -48,8 +52,8 @@ def main() -> int:
     ap.add_argument("--tile", type=int, default=None)
     ap.add_argument(
         "--expand", nargs="+",
-        default=["shift", "packed32", "sign16", "shift_u8", "nibble_const",
-                 "sign", "nibble"],
+        default=["shift", "shift_raw", "packed32", "sign16", "shift_u8",
+                 "nibble_const", "sign", "nibble"],
     )
     args = ap.parse_args()
 

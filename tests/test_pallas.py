@@ -70,7 +70,7 @@ def test_pallas_file_roundtrip(tmp_path):
 
 @pytest.mark.parametrize(
     "expand",
-    ["shift", "sign", "nibble",
+    ["shift", "shift_raw", "sign", "nibble",
      "packed32", "sign16", "shift_u8", "nibble_const"],  # r4 probe set
 )
 def test_pallas_expand_modes(expand):
@@ -96,7 +96,7 @@ def test_pallas_nibble_rejects_wide_field():
 
 @pytest.mark.parametrize(
     "expand",
-    ["shift", "sign", "nibble",
+    ["shift", "shift_raw", "sign", "nibble",
      "packed32", "sign16", "shift_u8", "nibble_const"],
 )
 def test_pallas_preparity_expand_modes(expand):
@@ -126,7 +126,25 @@ def test_pallas_wide_symbols(expand):
     np.testing.assert_array_equal(got, gf.matmul(A, B))
 
 
-@pytest.mark.parametrize("expand", ["shift", "sign", "nibble"])
+def test_pallas_shift_raw_wide_symbols():
+    """shift_raw at w=16: int8 acc is exact (mod-256 wrap is parity-safe);
+    bf16 acc is rejected (65535 exceeds bf16's exact-integer range)."""
+    import jax.numpy as jnp
+
+    gf = get_field(16)
+    rng = np.random.default_rng(26)
+    A = rng.integers(0, 1 << 16, size=(3, 5), dtype=np.uint16)
+    B = rng.integers(0, 1 << 16, size=(5, 600), dtype=np.uint16)
+    got = np.asarray(
+        gf_matmul_pallas(A, B, w=16, expand="shift_raw", acc_dtype=jnp.int8)
+    )
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+    with pytest.raises(ValueError, match="shift_raw"):
+        gf_matmul_pallas(A, B, w=16, expand="shift_raw",
+                         acc_dtype=jnp.bfloat16)
+
+
+@pytest.mark.parametrize("expand", ["shift", "shift_raw", "sign", "nibble"])
 def test_pallas_sign_int8_acc(expand):
     """int8 accumulation path (the TPU default) under both expansions."""
     import jax.numpy as jnp
