@@ -72,6 +72,15 @@ DEFAULT_TILE = 2048      # interpret / CPU-mesh default
 # (bench_captures/tile_pick_tpu_20260730T050344Z.jsonl: 64.33 @ 16384, 64.63 @
 # 32768 — a tie within tunnel jitter; 47.11 @ 8192, 56.91 @ 65536).
 TPU_TILE = 16384
+# The 2026-07-31 k-sweep capture (k_sweep_tpu_20260731T010808Z.jsonl,
+# k in {4,10,32,64,128} x {int8,bf16} x {8192,16384,32768}) splits the
+# default on contraction depth k*w: int8@16384 below depth 256 (k=10:
+# 64.7 vs bf16's 52), bf16@32768 at or above (k=32: 74.1, k=64: 102.8,
+# k=128: 87.2 — vs 42-67 for int8).  Unlike the reference, which degrades
+# for k >= 32 (design.tex:462-466), throughput GROWS with k: the p*w-row
+# output refold amortizes over more input rows.
+DEEP_CONTRACTION = 256   # k*w at/above which bf16@DEEP_TILE wins
+DEEP_TILE = 32768
 
 
 def _expand_shift(b, w, k, tile):
@@ -282,10 +291,12 @@ def gf_matmul_pallas(
 
     ``acc_dtype``: matmul input dtype — ``int8`` (int32 accumulation, exact
     for contraction depth < 2^31; 2x MXU rate on v5e) or ``bfloat16`` (f32
-    accumulation, exact for depth < 2^24).  Both bit-verified; defaults are
-    the measured-best per backend (committed v5e capture
-    bench_captures/tile_pick_tpu_20260730T050344Z.jsonl: int8 @ tile 16384 =
-    64.3 GB/s).
+    accumulation, exact for depth < 2^24).  Both bit-verified; TPU defaults
+    split on contraction depth k*w at w=8 — int8 @ tile 16384 below
+    DEEP_CONTRACTION (=256), bf16 @ tile 32768 at/above — per the committed
+    v5e captures (tile_pick_tpu_20260730T050344Z.jsonl,
+    k_sweep_tpu_20260731T010808Z.jsonl); other widths keep the shallow
+    defaults until a width-specific sweep is committed.
     ``expand``: data-expansion formulation — "shift" (default) or
     "shift_raw" (any width; w=16 needs acc_dtype=int8 — unmasked planes
     exceed bf16's exact-integer range), "sign" (w=8/16), or the
@@ -345,10 +356,17 @@ def gf_matmul_pallas(
         from ..utils.backend import tpu_devices_present
 
         interpret = not tpu_devices_present()
+    # The deep-contraction rule is only measured at w=8 (the k-sweep capture
+    # varies k with w=8); other widths keep the shallow defaults until a
+    # width-specific sweep lands.  shift_raw at w=16 requires int8 anyway.
+    deep = w == 8 and A.shape[1] * w >= DEEP_CONTRACTION
     if tile is None:
-        tile = DEFAULT_TILE if interpret else TPU_TILE
+        tile = DEFAULT_TILE if interpret else (DEEP_TILE if deep else TPU_TILE)
     if acc_dtype is None:
-        acc_dtype = jnp.bfloat16 if interpret else jnp.int8
+        if expand == "shift_raw" and w == 16:
+            acc_dtype = jnp.int8
+        else:
+            acc_dtype = jnp.bfloat16 if (interpret or deep) else jnp.int8
     if expand == "shift_raw" and w == 16 and acc_dtype != jnp.int8:
         # Unmasked 16-bit planes reach 65535; bf16 represents integers
         # exactly only up to 2^8, so rounding would corrupt the parity.

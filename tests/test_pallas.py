@@ -157,6 +157,43 @@ def test_pallas_sign_int8_acc(expand):
     np.testing.assert_array_equal(got, gf.matmul(A, B))
 
 
+def test_depth_aware_tpu_defaults(monkeypatch):
+    """On a TPU backend the tile/acc defaults split on contraction depth
+    k*w (committed capture k_sweep_tpu_20260731T010808Z.jsonl): int8@16384
+    below depth 256, bf16@32768 at/above.  Spied at the _pallas_matmul
+    boundary with a faked TPU presence — every combination is bit-exact,
+    so output equality cannot prove which default was chosen."""
+    import jax.numpy as jnp
+
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    seen = []
+    real = pg._pallas_matmul
+
+    def spy(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
+        seen.append((w, tile, acc_dtype))
+        # Run in interpret mode regardless (no real TPU under the test mesh)
+        return real(A, B, w, tile, acc_dtype, True, expand, fold)
+
+    monkeypatch.setattr(pg, "_pallas_matmul", spy)
+    monkeypatch.setattr(
+        "gpu_rscode_tpu.utils.backend.tpu_devices_present", lambda: True
+    )
+    gf = get_field(8)
+    rng = np.random.default_rng(27)
+    for k, want_tile, want_acc in [
+        (10, pg.TPU_TILE, jnp.int8),          # depth 80
+        (32, pg.DEEP_TILE, jnp.bfloat16),     # depth 256
+        (64, pg.DEEP_TILE, jnp.bfloat16),     # depth 512
+    ]:
+        A = rng.integers(0, 256, size=(4, k), dtype=np.uint8)
+        B = rng.integers(0, 256, size=(k, 512), dtype=np.uint8)
+        got = np.asarray(gf_matmul_pallas(A, B))
+        np.testing.assert_array_equal(got, gf.matmul(A, B))
+        w, tile, acc = seen[-1]
+        assert (tile, acc) == (want_tile, want_acc), (k, tile, acc)
+
+
 def test_expand_env_default(monkeypatch):
     """RS_PALLAS_EXPAND overrides the default formulation for whole-pipeline
     experiments; unknown/inapplicable values warn and fall back to shift,
