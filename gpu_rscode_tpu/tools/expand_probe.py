@@ -50,6 +50,11 @@ def main() -> int:
     ap.add_argument("--mb", type=int, default=320, help="data MB per call")
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--tile", type=int, default=None)
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--p", type=int, default=4)
+    ap.add_argument("--acc", choices=["int8", "bf16"], default=None,
+                    help="accumulator override (default: kernel's "
+                         "depth-aware choice)")
     ap.add_argument(
         "--expand", nargs="+",
         default=["shift", "shift_raw", "packed32", "sign16", "shift_u8",
@@ -61,19 +66,23 @@ def main() -> int:
 
     from .. import native
     from ..models.vandermonde import vandermonde_matrix
-    from ..ops.pallas_gemm import TPU_TILE, gf_matmul_pallas
+    from ..ops.pallas_gemm import gf_matmul_pallas
     from ..utils.backend import backend_label
     from ._bench_timing import time_device_fn
 
     import jax
 
+    import jax.numpy as jnp
+
     label = backend_label()
-    k, p = 10, 4
+    k, p = args.k, args.p
     m = (args.mb * 1024 * 1024) // k
-    tile = args.tile or TPU_TILE
+    tile = args.tile  # None -> the kernel's depth-aware default
+    acc = {"int8": jnp.int8, "bf16": jnp.bfloat16, None: None}[args.acc]
     print(
         f"# expand probe on {label}: k={k} p={p} data={k * m / 1e6:.0f} MB "
-        f"tile={tile} trials={args.trials}",
+        f"tile={tile or 'auto'} acc={args.acc or 'auto'} "
+        f"trials={args.trials}",
         file=sys.stderr, flush=True,
     )
 
@@ -89,7 +98,8 @@ def main() -> int:
     for expand in args.expand:
         try:
             got = np.asarray(
-                gf_matmul_pallas(Ad, Bd_small, expand=expand, tile=tile)
+                gf_matmul_pallas(Ad, Bd_small, expand=expand, tile=tile,
+                                 acc_dtype=acc)
             )
             if not np.array_equal(got, oracle):
                 results[expand] = "fail:OracleMismatch"
@@ -97,7 +107,8 @@ def main() -> int:
                 continue
 
             def run(e=expand):
-                return gf_matmul_pallas(Ad, Bd, expand=e, tile=tile)
+                return gf_matmul_pallas(Ad, Bd, expand=e, tile=tile,
+                                        acc_dtype=acc)
 
             dt = time_device_fn(run, trials=args.trials)
             gbps = k * m / dt / 1e9
