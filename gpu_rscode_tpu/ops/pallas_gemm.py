@@ -190,6 +190,21 @@ def _expand_nibble_const(b, w, k, tile):
 def _kernel(
     a_ref, b_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand, fold
 ):
+    _kernel_body(a_ref, b_ref, None, o_ref, w=w, k=k, p=p,
+                 acc_dtype=acc_dtype, expand=expand, fold=fold)
+
+
+def _kernel_dotfold(
+    a_ref, b_ref, f_ref, o_ref, *, w: int, k: int, p: int, acc_dtype, expand,
+    fold,
+):
+    _kernel_body(a_ref, b_ref, f_ref, o_ref, w=w, k=k, p=p,
+                 acc_dtype=acc_dtype, expand=expand, fold=fold)
+
+
+def _kernel_body(
+    a_ref, b_ref, f_ref, o_ref, *, w, k, p, acc_dtype, expand, fold
+):
     tile = b_ref.shape[-1]
     expander = {
         "sign": _expand_sign,
@@ -217,6 +232,17 @@ def _kernel(
     # two's-complement (-n) & 1 == n & 1, and f32->int32 truncation is exact
     # for these small integers.
     bits = acc.astype(jnp.int32) & 1
+    if f_ref is not None:
+        # MXU refold: out = F . bits with F (p, p*w) the constant
+        # bit-weight operator (2^s on the diagonal blocks, passed as an
+        # operand — Pallas kernels may not capture array constants).  The
+        # VPU's per-output shift + w-way sum becomes one tiny bf16 matmul;
+        # exact in f32 (values <= 2^w - 1 < 2^24).
+        o_ref[:] = jnp.dot(
+            f_ref[:], bits.astype(jnp.bfloat16),
+            preferred_element_type=jnp.float32,
+        ).astype(o_ref.dtype)
+        return
     out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
     o_ref[:] = (
         jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1)
@@ -226,9 +252,13 @@ def _kernel(
 
 @functools.partial(
     jax.jit,
-    static_argnames=("w", "tile", "acc_dtype", "interpret", "expand", "fold"),
+    static_argnames=(
+        "w", "tile", "acc_dtype", "interpret", "expand", "fold", "refold",
+    ),
 )
-def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
+def _pallas_matmul(
+    A, B, w, tile, acc_dtype, interpret, expand, fold=True, refold="sum"
+):
     gf = get_field(w)
     p, k = A.shape
     _, m = B.shape
@@ -253,22 +283,37 @@ def _pallas_matmul(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
     tile = min(tile, ((m + 127) // 128) * 128)
     grid = (pl.cdiv(m, tile),)
     out_rows = p if fold else p * w
-    return pl.pallas_call(
-        functools.partial(
+    in_specs = [
+        pl.BlockSpec((p * w, a_cols), lambda i: (0, 0)),
+        pl.BlockSpec((k, tile), lambda i: (0, i)),
+    ]
+    operands = [a_bits, B]
+    if fold and refold == "dot":
+        # (p, p*w) bit-weight fold operator: F[i, i*w + s] = 2^s.
+        F = jnp.asarray(
+            np.kron(np.eye(p), (1 << np.arange(w))[None, :]), jnp.bfloat16
+        )
+        kernel = functools.partial(
+            _kernel_dotfold, w=w, k=k, p=p, acc_dtype=acc_dtype,
+            expand=expand, fold=fold,
+        )
+        in_specs.append(pl.BlockSpec((p, p * w), lambda i: (0, 0)))
+        operands.append(F)
+    else:
+        kernel = functools.partial(
             _kernel, w=w, k=k, p=p, acc_dtype=acc_dtype, expand=expand,
             fold=fold,
-        ),
+        )
+    return pl.pallas_call(
+        kernel,
         out_shape=jax.ShapeDtypeStruct(
             (out_rows, m), out_dtype if fold else jnp.int32
         ),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((p * w, a_cols), lambda i: (0, 0)),
-            pl.BlockSpec((k, tile), lambda i: (0, i)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((out_rows, tile), lambda i: (0, i)),
         interpret=interpret,
-    )(a_bits, B)
+    )(*operands)
 
 
 def gf_matmul_pallas(
@@ -280,6 +325,7 @@ def gf_matmul_pallas(
     interpret: bool | None = None,
     expand: str | None = None,
     fold_parity: bool = True,
+    refold: str | None = None,
 ):
     """``C = A . B`` over GF(2^w) via the fused Pallas kernel.
 
@@ -306,6 +352,10 @@ def gf_matmul_pallas(
     "shift"/"shift_raw" lower to hardware — the rest fail Mosaic
     legalization (see the module docstring's hardware verdict and
     bench_captures/expand_probe_*) and serve interpret mode.
+    ``refold``: how the kernel folds accumulator parities back into GF
+    elements — "sum" (VPU: bits << s summed over w) or "dot" (MXU: one
+    tiny bf16 matmul against the (p, p*w) bit-weight operator; exact in
+    f32 for any supported w).  Env-overridable via RS_PALLAS_REFOLD.
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
@@ -386,6 +436,23 @@ def gf_matmul_pallas(
             raise ValueError(
                 "expand='shift_raw' at w=16 requires acc_dtype=int8"
             )
+    if refold is None:
+        # Env override for whole-pipeline hardware experiments, mirroring
+        # RS_PALLAS_EXPAND; an explicit refold argument always wins.
+        import os
+
+        refold = os.environ.get("RS_PALLAS_REFOLD") or "sum"
+        if refold not in ("sum", "dot"):
+            import warnings
+
+            warnings.warn(
+                f"RS_PALLAS_REFOLD={refold!r} is unknown; using 'sum'",
+                stacklevel=2,
+            )
+            refold = "sum"
+    if refold not in ("sum", "dot"):
+        raise ValueError(f"unknown refold {refold!r}")
     return _pallas_matmul(
-        A, B, w, tile, acc_dtype, interpret, expand, fold=fold_parity
+        A, B, w, tile, acc_dtype, interpret, expand, fold=fold_parity,
+        refold=refold,
     )

@@ -55,6 +55,9 @@ def main() -> int:
     ap.add_argument("--acc", choices=["int8", "bf16"], default=None,
                     help="accumulator override (default: kernel's "
                          "depth-aware choice)")
+    ap.add_argument("--refold", choices=["sum", "dot"], default=None,
+                    help="parity refold: VPU shift-sum or MXU dot "
+                         "(default: kernel's choice / RS_PALLAS_REFOLD)")
     ap.add_argument(
         "--expand", nargs="+",
         default=["shift", "shift_raw", "packed32", "sign16", "shift_u8",
@@ -82,7 +85,7 @@ def main() -> int:
     print(
         f"# expand probe on {label}: k={k} p={p} data={k * m / 1e6:.0f} MB "
         f"tile={tile or 'auto'} acc={args.acc or 'auto'} "
-        f"trials={args.trials}",
+        f"refold={args.refold or 'auto'} trials={args.trials}",
         file=sys.stderr, flush=True,
     )
 
@@ -99,7 +102,7 @@ def main() -> int:
         try:
             got = np.asarray(
                 gf_matmul_pallas(Ad, Bd_small, expand=expand, tile=tile,
-                                 acc_dtype=acc)
+                                 acc_dtype=acc, refold=args.refold)
             )
             if not np.array_equal(got, oracle):
                 results[expand] = "fail:OracleMismatch"
@@ -108,7 +111,7 @@ def main() -> int:
 
             def run(e=expand):
                 return gf_matmul_pallas(Ad, Bd, expand=e, tile=tile,
-                                        acc_dtype=acc)
+                                        acc_dtype=acc, refold=args.refold)
 
             dt = time_device_fn(run, trials=args.trials)
             gbps = k * m / dt / 1e9

@@ -157,6 +157,65 @@ def test_pallas_sign_int8_acc(expand):
     np.testing.assert_array_equal(got, gf.matmul(A, B))
 
 
+@pytest.mark.parametrize("expand", ["shift", "shift_raw"])
+@pytest.mark.parametrize("w", [8, 16])
+def test_pallas_dot_refold(expand, w):
+    """refold='dot' (MXU parity refold via the (p, p*w) bit-weight
+    operator) is bit-exact at both widths; powers of two are exact in
+    bf16 and the folded values stay below 2^24 in f32."""
+    import jax.numpy as jnp
+
+    gf = get_field(w)
+    dt = np.uint8 if w == 8 else np.uint16
+    rng = np.random.default_rng(29)
+    A = rng.integers(0, 1 << w, size=(4, 6), dtype=dt)
+    B = rng.integers(0, 1 << w, size=(6, 640), dtype=dt)
+    kw = {"acc_dtype": jnp.int8} if (w == 16 and expand == "shift_raw") else {}
+    got = np.asarray(
+        gf_matmul_pallas(A, B, w=w, expand=expand, refold="dot", **kw)
+    )
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
+def _spy_matmul(monkeypatch, seen, force_interpret=False):
+    """Route _pallas_matmul through a recording spy (one shared signature
+    to maintain when the kernel entry grows a parameter)."""
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    real = pg._pallas_matmul
+
+    def spy(A, B, w, tile, acc_dtype, interpret, expand, fold=True,
+            refold="sum"):
+        seen.append(dict(w=w, tile=tile, acc_dtype=acc_dtype,
+                         expand=expand, refold=refold))
+        return real(A, B, w, tile, acc_dtype,
+                    True if force_interpret else interpret,
+                    expand, fold, refold)
+
+    monkeypatch.setattr(pg, "_pallas_matmul", spy)
+
+
+def test_refold_env_override(monkeypatch):
+    """RS_PALLAS_REFOLD routes the default refold for whole-pipeline
+    experiments; unknown values warn and fall back to 'sum'."""
+    seen = []
+    _spy_matmul(monkeypatch, seen)
+    gf = get_field(8)
+    rng = np.random.default_rng(30)
+    A = rng.integers(0, 256, size=(2, 4), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+    want = gf.matmul(A, B)
+    monkeypatch.setenv("RS_PALLAS_REFOLD", "dot")
+    np.testing.assert_array_equal(np.asarray(gf_matmul_pallas(A, B)), want)
+    assert seen[-1]["refold"] == "dot"
+    monkeypatch.setenv("RS_PALLAS_REFOLD", "bogus")
+    with pytest.warns(UserWarning, match="RS_PALLAS_REFOLD"):
+        np.testing.assert_array_equal(
+            np.asarray(gf_matmul_pallas(A, B)), want
+        )
+    assert seen[-1]["refold"] == "sum"
+
+
 def test_depth_aware_tpu_defaults(monkeypatch):
     """On a TPU backend the tile/acc defaults split on contraction depth
     k*w (committed capture k_sweep_tpu_20260731T010808Z.jsonl): int8@16384
@@ -168,14 +227,8 @@ def test_depth_aware_tpu_defaults(monkeypatch):
     from gpu_rscode_tpu.ops import pallas_gemm as pg
 
     seen = []
-    real = pg._pallas_matmul
-
-    def spy(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
-        seen.append((w, tile, acc_dtype))
-        # Run in interpret mode regardless (no real TPU under the test mesh)
-        return real(A, B, w, tile, acc_dtype, True, expand, fold)
-
-    monkeypatch.setattr(pg, "_pallas_matmul", spy)
+    # Run in interpret mode regardless (no real TPU under the test mesh)
+    _spy_matmul(monkeypatch, seen, force_interpret=True)
     monkeypatch.setattr(
         "gpu_rscode_tpu.utils.backend.tpu_devices_present", lambda: True
     )
@@ -190,8 +243,9 @@ def test_depth_aware_tpu_defaults(monkeypatch):
         B = rng.integers(0, 256, size=(k, 512), dtype=np.uint8)
         got = np.asarray(gf_matmul_pallas(A, B))
         np.testing.assert_array_equal(got, gf.matmul(A, B))
-        w, tile, acc = seen[-1]
-        assert (tile, acc) == (want_tile, want_acc), (k, tile, acc)
+        last = seen[-1]
+        assert (last["tile"], last["acc_dtype"]) == (want_tile, want_acc), \
+            (k, last)
 
 
 def test_expand_env_default(monkeypatch):
@@ -200,16 +254,8 @@ def test_expand_env_default(monkeypatch):
     and an explicit expand= argument always wins.  The formulation actually
     reaching the kernel is spied on — every expansion is bit-identical, so
     output equality alone cannot prove the env var was honored."""
-    from gpu_rscode_tpu.ops import pallas_gemm as pg
-
     seen = []
-    real = pg._pallas_matmul
-
-    def spy(A, B, w, tile, acc_dtype, interpret, expand, fold=True):
-        seen.append(expand)
-        return real(A, B, w, tile, acc_dtype, interpret, expand, fold)
-
-    monkeypatch.setattr(pg, "_pallas_matmul", spy)
+    _spy_matmul(monkeypatch, seen)
     rng = np.random.default_rng(3)
     A = rng.integers(0, 256, size=(2, 4), dtype=np.uint8)
     B = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
@@ -217,7 +263,7 @@ def test_expand_env_default(monkeypatch):
     monkeypatch.setenv("RS_PALLAS_EXPAND", "packed32")
     got = np.asarray(gf_matmul_pallas(A, B))  # env default applies (w=8)
     np.testing.assert_array_equal(got, want)
-    assert seen[-1] == "packed32"
+    assert seen[-1]["expand"] == "packed32"
     # w=16 cannot run a byte-granular strategy: env warns, falls to shift.
     A16 = rng.integers(0, 1 << 16, size=(2, 4), dtype=np.uint16)
     B16 = rng.integers(0, 1 << 16, size=(4, 512), dtype=np.uint16)
@@ -225,15 +271,15 @@ def test_expand_env_default(monkeypatch):
     with pytest.warns(UserWarning, match="does not apply"):
         got16 = np.asarray(gf_matmul_pallas(A16, B16, w=16))
     np.testing.assert_array_equal(got16, want16)
-    assert seen[-1] == "shift"
+    assert seen[-1]["expand"] == "shift"
     # an env typo warns and falls back instead of crashing production
     monkeypatch.setenv("RS_PALLAS_EXPAND", "packed_32")
     with pytest.warns(UserWarning, match="unknown"):
         got2 = np.asarray(gf_matmul_pallas(A, B))
     np.testing.assert_array_equal(got2, want)
-    assert seen[-1] == "shift"
+    assert seen[-1]["expand"] == "shift"
     # explicit argument wins over the env var (no warning, no fallback)
     monkeypatch.setenv("RS_PALLAS_EXPAND", "nonsense")
     got3 = np.asarray(gf_matmul_pallas(A, B, expand="sign"))
     np.testing.assert_array_equal(got3, want)
-    assert seen[-1] == "sign"
+    assert seen[-1]["expand"] == "sign"
