@@ -1,0 +1,39 @@
+#!/bin/bash
+# Round-4b follow-up probe set: hardware verdicts for the two new kernel
+# formulations (shift_raw expansion, MXU dot refold) at the headline
+# (k=10, int8@16384) and deep (k=64, bf16@32768) operating points, plus
+# the decode shape (p=k).  Commits after every capture — same convention
+# as tpu_capture_r4.sh.  Run only when the tunnel is otherwise idle.
+set -u
+cd /root/repo
+mkdir -p bench_captures
+START=$SECONDS
+
+capture() {  # capture <name> <timeout> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  local ts
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  local out="bench_captures/${name}_tpu_${ts}.jsonl"
+  echo "# [$((SECONDS - START))s] capturing ${name} (timeout ${tmo}s)" >&2
+  timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
+  local rc=$?
+  echo "# ${name} rc=${rc}" >&2
+  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
+  if [ -s "$out" ]; then
+    git add "$out" "${out%.jsonl}.log" 2>/dev/null
+    git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
+  else
+    rm -f "$out"
+  fi
+  return $rc
+}
+
+P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
+capture expand_r4b_k10 900 "${P[@]}" --expand shift shift_raw
+capture expand_r4b_k10_dot 900 "${P[@]}" --expand shift shift_raw --refold dot
+capture expand_r4b_k64 900 "${P[@]}" --k 64 --expand shift shift_raw
+capture expand_r4b_k64_dot 900 "${P[@]}" --k 64 --expand shift shift_raw --refold dot
+# Decode shape: square coefficient matrix (p = k)
+capture expand_r4b_decode 900 "${P[@]}" --k 10 --p 10 --expand shift shift_raw
+capture expand_r4b_decode_dot 900 "${P[@]}" --k 10 --p 10 --expand shift shift_raw --refold dot
+echo "# round-4b probe set complete" >&2
