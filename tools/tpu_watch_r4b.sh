@@ -1,0 +1,26 @@
+#!/bin/bash
+# Watch the axon tunnel; on the first healthy probe, run the round-4b
+# probe set (tools/tpu_probe_r4b.sh).  Records every probe attempt so the
+# tunnel-health history stays auditable (bench_captures/tunnel_probes_*).
+set -u
+cd /root/repo
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; running round-4b probes" >&2
+    tools/tpu_probe_r4b.sh
+    exit $?
+  fi
+  sleep 60
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
