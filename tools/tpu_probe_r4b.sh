@@ -36,4 +36,12 @@ capture expand_r4b_k64_dot 900 "${P[@]}" --k 64 --expand shift shift_raw --refol
 # Decode shape: square coefficient matrix (p = k)
 capture expand_r4b_decode 900 "${P[@]}" --k 10 --p 10 --expand shift shift_raw
 capture expand_r4b_decode_dot 900 "${P[@]}" --k 10 --p 10 --expand shift shift_raw --refold dot
+# Wedged-tunnel casualties from the r4 set, cheapest first; the stream
+# bench goes LAST — its heavy host<->device transfer pattern over the
+# tunnel is the likeliest wedge trigger.
+capture inverse 900 python -m gpu_rscode_tpu.tools.inverse_bench
+mkdir -p /dev/shm/rs_stream
+capture stream_tmpfs 1200 python -m gpu_rscode_tpu.tools.stream_bench \
+  --mb 256 --dir /dev/shm/rs_stream --seg-mb 64
+rm -rf /dev/shm/rs_stream
 echo "# round-4b probe set complete" >&2
