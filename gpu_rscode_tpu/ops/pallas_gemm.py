@@ -131,6 +131,15 @@ def _expand_nibble(b, w, k, tile):
 # are numpy CONSTANTS, no iota op; no int8 subtraction).  All bit-verified
 # in interpret mode; hardware verdicts land in
 # bench_captures/expand_probe_* via tools/expand_probe.py.
+# Hardware verdicts 2026-07-31 (expand_probe_tpu_20260731T010620Z.jsonl):
+# packed32 hits an unimplemented Mosaic bitcast; sign16/shift_u8/
+# nibble_const crash the remote compile helper — no narrow-lane VPU
+# formulation lowers on this toolchain.  The follow-ups that stay in the
+# lowerable int32-lane family are ``shift_raw`` (above) and ``pack2``
+# (``_kernel_pack2``): two bytes per int32 lane via an XLA-level uint16
+# bitcast OUTSIDE the kernel, f32 MXU contraction with 8-bit parity
+# fields (exact below depth 256), and a packed refold whose lane value is
+# already the two output bytes — half the VPU work per byte at both ends.
 
 
 def _expand_packed32(b, w, k, tile):
@@ -185,6 +194,36 @@ def _expand_nibble_const(b, w, k, tile):
         axis=1,
     )  # (k, 32, tile)
     return planes.reshape(k * 32, tile)
+
+
+def _kernel_pack2(a_ref, b_ref, o_ref, *, w: int, k: int, p: int):
+    # Two data bytes per int32 lane (VERDICT r3 candidate (b), realized
+    # without the in-kernel bitcast Mosaic refuses: the uint16 view is an
+    # XLA-level bitcast OUTSIDE the kernel).  Each plane row holds bit s of
+    # BOTH bytes at int32 bit positions 0 and 8 (mask 0x0101); the f32
+    # matmul accumulates the two parity fields independently — field sums
+    # are bounded by the contraction depth k*w < 256, so no cross-field
+    # carry, and every value is far below 2^24 (f32-exact on the MXU).
+    # The packed shift-sum refold then produces, per lane, exactly
+    # lo_out + 256*hi_out — i.e. the uint16 of the two output bytes in the
+    # same byte order the input bitcast used (the algebra is symmetric
+    # under endianness, so the pair of bitcasts cancels either way).
+    # Net: HALF the VPU lane-ops per byte in BOTH expansion and refold.
+    tile2 = b_ref.shape[-1]
+    v = b_ref[:].astype(jnp.int32)
+    planes = jnp.stack(
+        [(v >> np.int32(s)) & np.int32(0x0101) for s in range(w)], axis=1
+    ).reshape(k * w, tile2)
+    acc = jnp.dot(
+        a_ref[:], planes.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    bits = acc.astype(jnp.int32) & 0x0101
+    out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
+    o_ref[:] = (
+        jnp.sum(bits.reshape(p, w, tile2) << out_shifts, axis=1)
+        .astype(jnp.uint16)
+    )
 
 
 def _kernel(
@@ -248,6 +287,35 @@ def _kernel_body(
         jnp.sum(bits.reshape(p, w, tile) << out_shifts, axis=1)
         .astype(o_ref.dtype)
     )
+
+
+@functools.partial(jax.jit, static_argnames=("w", "tile", "interpret"))
+def _pallas_matmul_pack2(A, B, w, tile, interpret):
+    from .gemm import expand_bitmatrix_jnp
+
+    p, k = A.shape
+    _, m = B.shape
+    a_op = expand_bitmatrix_jnp(A, w).astype(jnp.float32)
+    pad = m % 2
+    if pad:
+        B = jnp.pad(B, ((0, 0), (0, 1)))
+    m2 = (m + pad) // 2
+    B16 = jax.lax.bitcast_convert_type(B.reshape(k, m2, 2), jnp.uint16)
+    tile2 = min(tile // 2, ((m2 + 127) // 128) * 128)
+    grid = (pl.cdiv(m2, tile2),)
+    out16 = pl.pallas_call(
+        functools.partial(_kernel_pack2, w=w, k=k, p=p),
+        out_shape=jax.ShapeDtypeStruct((p, m2), jnp.uint16),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((p * w, k * w), lambda i: (0, 0)),
+            pl.BlockSpec((k, tile2), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((p, tile2), lambda i: (0, i)),
+        interpret=interpret,
+    )(a_op, B16)
+    out = jax.lax.bitcast_convert_type(out16, jnp.uint8).reshape(p, 2 * m2)
+    return out[:, :m] if pad else out
 
 
 @functools.partial(
@@ -316,6 +384,16 @@ def _pallas_matmul(
     )(*operands)
 
 
+def _fallback_to_shift(reason: str) -> str:
+    """Env-selected modes keep the warn-and-fall-back guarantee: an env
+    value that is unknown or inapplicable must neither crash production
+    nor silently record a capture under the wrong formulation."""
+    import warnings
+
+    warnings.warn(f"{reason}; using 'shift'", stacklevel=3)
+    return "shift"
+
+
 def gf_matmul_pallas(
     A,
     B,
@@ -347,11 +425,15 @@ def gf_matmul_pallas(
     "shift_raw" (any width; w=16 needs acc_dtype=int8 — unmasked planes
     exceed bf16's exact-integer range), "sign" (w=8/16), or the
     byte-granular set "nibble"/"nibble_const"/"packed32"/"sign16"/
-    "shift_u8" (w=8 only; the nibble pair one-hots against the (p*w, k*32)
-    operator; see module docstring).  On the current TPU toolchain only
-    "shift"/"shift_raw" lower to hardware — the rest fail Mosaic
-    legalization (see the module docstring's hardware verdict and
-    bench_captures/expand_probe_*) and serve interpret mode.
+    "shift_u8"/"pack2" (w=8 only; the nibble pair one-hots against the
+    (p*w, k*32) operator; see module docstring).  "pack2" additionally
+    requires contraction depth k*w < 256 and fold_parity=True, and runs a
+    fixed f32/packed-refold pipeline — passing acc_dtype or refold with
+    it raises.  On the current TPU toolchain only "shift"/"shift_raw"
+    (and, pending a capture, "pack2" — it avoids every previously refused
+    op) lower to hardware — the rest fail Mosaic legalization (see the
+    module docstring's hardware verdict and bench_captures/expand_probe_*)
+    and serve interpret mode.
     ``refold``: how the kernel folds accumulator parities back into GF
     elements — "sum" (VPU: bits << s summed over w) or "dot" (MXU: one
     tiny bf16 matmul against the (p, p*w) bit-weight operator; exact in
@@ -359,7 +441,9 @@ def gf_matmul_pallas(
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
-    _BYTE_ONLY = ("nibble", "nibble_const", "packed32", "sign16", "shift_u8")
+    _BYTE_ONLY = (
+        "nibble", "nibble_const", "packed32", "sign16", "shift_u8", "pack2",
+    )
     _ANY_W = ("shift", "shift_raw")
     from_env = False
     if expand is None:
@@ -378,14 +462,10 @@ def gf_matmul_pallas(
             expand in _ANY_W or w == 8 or (w == 16 and expand == "sign")
         )
         if not applies:
-            import warnings
-
-            warnings.warn(
+            expand = _fallback_to_shift(
                 f"RS_PALLAS_EXPAND={expand!r} is unknown or does not apply "
-                f"at w={w}; using 'shift'",
-                stacklevel=2,
+                f"at w={w}"
             )
-            expand = "shift"
     if expand not in _ANY_W + ("sign",) + _BYTE_ONLY:
         raise ValueError(f"unknown expand {expand!r}")
     if expand == "sign" and w not in (8, 16):
@@ -400,6 +480,20 @@ def gf_matmul_pallas(
         )
     A = jnp.asarray(A)
     B = jnp.asarray(B)
+    if expand == "pack2" and (not fold_parity or A.shape[1] * w >= 256):
+        # Packed parity fields are 8 bits wide: the contraction depth k*w
+        # must stay below 256, and the pre-parity (stripe-psum) form cannot
+        # be emitted (the accumulator lanes hold two packed fields).
+        why = (
+            "pack2 cannot emit pre-parity accumulators" if not fold_parity
+            else "pack2 requires contraction depth k*w < 256"
+        )
+        if from_env:
+            expand = _fallback_to_shift(
+                f"RS_PALLAS_EXPAND=pack2 does not apply here ({why})"
+            )
+        else:
+            raise ValueError(why)
     if interpret is None:
         # Device-platform check, not backend name: a tunnel backend serving
         # real TPU chips must compile, not interpret (utils/backend.py).
@@ -412,6 +506,7 @@ def gf_matmul_pallas(
     deep = w == 8 and A.shape[1] * w >= DEEP_CONTRACTION
     if tile is None:
         tile = DEFAULT_TILE if interpret else (DEEP_TILE if deep else TPU_TILE)
+    acc_explicit = acc_dtype is not None
     if acc_dtype is None:
         if expand == "shift_raw" and w == 16:
             acc_dtype = jnp.int8
@@ -424,18 +519,24 @@ def gf_matmul_pallas(
         # and exact in bf16.)  Env-selected modes keep the warn-and-fall-
         # back guarantee instead of crashing production.
         if from_env:
-            import warnings
-
-            warnings.warn(
-                "RS_PALLAS_EXPAND=shift_raw needs acc_dtype=int8 at w=16; "
-                "using 'shift'",
-                stacklevel=2,
+            expand = _fallback_to_shift(
+                "RS_PALLAS_EXPAND=shift_raw needs acc_dtype=int8 at w=16"
             )
-            expand = "shift"
         else:
             raise ValueError(
                 "expand='shift_raw' at w=16 requires acc_dtype=int8"
             )
+    if expand == "pack2":
+        # Self-contained path: f32 accumulation (exact; fields < 256) and
+        # the packed shift-sum refold.  Explicit acc_dtype/refold must not
+        # be silently ignored — a probe capture would be labeled with a
+        # configuration that never ran.
+        if acc_explicit or refold is not None:
+            raise ValueError(
+                "pack2 has a fixed f32/packed-refold pipeline; "
+                "acc_dtype and refold do not apply"
+            )
+        return _pallas_matmul_pack2(A, B, w, tile, interpret)
     if refold is None:
         # Env override for whole-pipeline hardware experiments, mirroring
         # RS_PALLAS_EXPAND; an explicit refold argument always wins.

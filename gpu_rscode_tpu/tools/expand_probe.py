@@ -60,8 +60,8 @@ def main() -> int:
                          "(default: kernel's choice / RS_PALLAS_REFOLD)")
     ap.add_argument(
         "--expand", nargs="+",
-        default=["shift", "shift_raw", "packed32", "sign16", "shift_u8",
-                 "nibble_const", "sign", "nibble"],
+        default=["shift", "shift_raw", "pack2", "packed32", "sign16",
+                 "shift_u8", "nibble_const", "sign", "nibble"],
     )
     args = ap.parse_args()
 

@@ -71,7 +71,7 @@ def test_pallas_file_roundtrip(tmp_path):
 @pytest.mark.parametrize(
     "expand",
     ["shift", "shift_raw", "sign", "nibble",
-     "packed32", "sign16", "shift_u8", "nibble_const"],  # r4 probe set
+     "packed32", "sign16", "shift_u8", "nibble_const", "pack2"],  # r4 set
 )
 def test_pallas_expand_modes(expand):
     """All data-expansion formulations are bit-exact (the sign trick's
@@ -174,6 +174,39 @@ def test_pallas_dot_refold(expand, w):
     got = np.asarray(
         gf_matmul_pallas(A, B, w=w, expand=expand, refold="dot", **kw)
     )
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
+def test_pallas_pack2():
+    """pack2 (two bytes per int32 lane through an outside-the-kernel u16
+    bitcast): odd column counts pad/slice, the depth bound k*w < 256 and
+    the pre-parity form are rejected, and the env fallback downgrades."""
+    gf = get_field(8)
+    rng = np.random.default_rng(31)
+    for m in (511, 512, 4097):  # odd, even, tile-overhang odd
+        A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+        B = rng.integers(0, 256, size=(10, m), dtype=np.uint8)
+        got = np.asarray(gf_matmul_pallas(A, B, expand="pack2", tile=2048))
+        np.testing.assert_array_equal(got, gf.matmul(A, B))
+    A32 = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
+    B32 = rng.integers(0, 256, size=(32, 256), dtype=np.uint8)
+    with pytest.raises(ValueError, match="k\\*w < 256"):
+        gf_matmul_pallas(A32, B32, expand="pack2")
+    A, B = A32[:, :10], B32[:10]
+    with pytest.raises(ValueError, match="pre-parity"):
+        gf_matmul_pallas(A, B, expand="pack2", fold_parity=False)
+
+
+def test_pallas_pack2_env_fallback(monkeypatch):
+    """RS_PALLAS_EXPAND=pack2 on an inapplicable call (deep contraction)
+    warns and falls back instead of crashing production."""
+    gf = get_field(8)
+    rng = np.random.default_rng(32)
+    A = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(32, 256), dtype=np.uint8)
+    monkeypatch.setenv("RS_PALLAS_EXPAND", "pack2")
+    with pytest.warns(UserWarning, match="does not apply"):
+        got = np.asarray(gf_matmul_pallas(A, B))
     np.testing.assert_array_equal(got, gf.matmul(A, B))
 
 
