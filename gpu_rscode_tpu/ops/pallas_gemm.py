@@ -427,9 +427,9 @@ def gf_matmul_pallas(
     byte-granular set "nibble"/"nibble_const"/"packed32"/"sign16"/
     "shift_u8"/"pack2" (w=8 only; the nibble pair one-hots against the
     (p*w, k*32) operator; see module docstring).  "pack2" additionally
-    requires contraction depth k*w < 256 and fold_parity=True, and runs a
-    fixed f32/packed-refold pipeline — passing acc_dtype or refold with
-    it raises.  On the current TPU toolchain only "shift"/"shift_raw"
+    requires fold_parity=True and runs a fixed f32/packed-refold pipeline
+    (passing acc_dtype or refold with it raises); contractions deeper than
+    k*w < 256 split into carry-free depth slices XORed together.  On the current TPU toolchain only "shift"/"shift_raw"
     (and, pending a capture, "pack2" — it avoids every previously refused
     op) lower to hardware — the rest fail Mosaic legalization (see the
     module docstring's hardware verdict and bench_captures/expand_probe_*)
@@ -480,14 +480,11 @@ def gf_matmul_pallas(
         )
     A = jnp.asarray(A)
     B = jnp.asarray(B)
-    if expand == "pack2" and (not fold_parity or A.shape[1] * w >= 256):
-        # Packed parity fields are 8 bits wide: the contraction depth k*w
-        # must stay below 256, and the pre-parity (stripe-psum) form cannot
-        # be emitted (the accumulator lanes hold two packed fields).
-        why = (
-            "pack2 cannot emit pre-parity accumulators" if not fold_parity
-            else "pack2 requires contraction depth k*w < 256"
-        )
+    if expand == "pack2" and not fold_parity:
+        # The pre-parity (stripe-psum) form cannot be emitted: the
+        # accumulator lanes hold two packed 8-bit parity fields, not the
+        # per-column bit-plane accumulators from_bitplanes expects.
+        why = "pack2 cannot emit pre-parity accumulators"
         if from_env:
             expand = _fallback_to_shift(
                 f"RS_PALLAS_EXPAND=pack2 does not apply here ({why})"
@@ -536,7 +533,24 @@ def gf_matmul_pallas(
                 "pack2 has a fixed f32/packed-refold pipeline; "
                 "acc_dtype and refold do not apply"
             )
-        return _pallas_matmul_pack2(A, B, w, tile, interpret)
+        k_all = A.shape[1]
+        k_c = (256 // w) - 1  # per-slice depth k_c*w <= 248 < 256
+        if k_all <= k_c:
+            return _pallas_matmul_pack2(A, B, w, tile, interpret)
+        # Split-k: the packed parity fields are only carry-free below
+        # depth 256, so deeper contractions run as ceil(k/k_c) carry-free
+        # slices XORed together (XOR is the field addition, so slicing the
+        # contraction is exact).  Each slice reads only its own k rows —
+        # total input traffic is unchanged; the extra cost is the (p, m)
+        # slice outputs and their XORs, cheap while p << k and affordable
+        # even at p = k (HBM has 4x headroom over the measured kernel).
+        out = None
+        for c0 in range(0, k_all, k_c):
+            part = _pallas_matmul_pack2(
+                A[:, c0:c0 + k_c], B[c0:c0 + k_c], w, tile, interpret
+            )
+            out = part if out is None else out ^ part
+        return out
     if refold is None:
         # Env override for whole-pipeline hardware experiments, mirroring
         # RS_PALLAS_EXPAND; an explicit refold argument always wins.

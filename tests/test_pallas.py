@@ -188,25 +188,37 @@ def test_pallas_pack2():
         B = rng.integers(0, 256, size=(10, m), dtype=np.uint8)
         got = np.asarray(gf_matmul_pallas(A, B, expand="pack2", tile=2048))
         np.testing.assert_array_equal(got, gf.matmul(A, B))
-    A32 = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
-    B32 = rng.integers(0, 256, size=(32, 256), dtype=np.uint8)
-    with pytest.raises(ValueError, match="k\\*w < 256"):
-        gf_matmul_pallas(A32, B32, expand="pack2")
-    A, B = A32[:, :10], B32[:10]
+    A, B = (rng.integers(0, 256, size=(4, 10), dtype=np.uint8),
+            rng.integers(0, 256, size=(10, 256), dtype=np.uint8))
     with pytest.raises(ValueError, match="pre-parity"):
         gf_matmul_pallas(A, B, expand="pack2", fold_parity=False)
 
 
+@pytest.mark.parametrize("k", [31, 32, 63, 128])
+def test_pallas_pack2_split_k(k):
+    """Deep contractions (k*w >= 256) run pack2 as carry-free depth-248
+    slices XORed together — exact because XOR is the field addition."""
+    gf = get_field(8)
+    rng = np.random.default_rng(33)
+    A = rng.integers(0, 256, size=(4, k), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(k, 512), dtype=np.uint8)
+    got = np.asarray(gf_matmul_pallas(A, B, expand="pack2"))
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
 def test_pallas_pack2_env_fallback(monkeypatch):
-    """RS_PALLAS_EXPAND=pack2 on an inapplicable call (deep contraction)
-    warns and falls back instead of crashing production."""
+    """RS_PALLAS_EXPAND=pack2 on an inapplicable call (the pre-parity
+    stripe form) warns and falls back instead of crashing production."""
+    from gpu_rscode_tpu.ops.gemm import from_bitplanes
+
     gf = get_field(8)
     rng = np.random.default_rng(32)
-    A = rng.integers(0, 256, size=(4, 32), dtype=np.uint8)
-    B = rng.integers(0, 256, size=(32, 256), dtype=np.uint8)
+    A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(10, 256), dtype=np.uint8)
     monkeypatch.setenv("RS_PALLAS_EXPAND", "pack2")
     with pytest.warns(UserWarning, match="does not apply"):
-        got = np.asarray(gf_matmul_pallas(A, B))
+        acc = gf_matmul_pallas(A, B, fold_parity=False)
+    got = np.asarray(from_bitplanes(acc, 8))
     np.testing.assert_array_equal(got, gf.matmul(A, B))
 
 

@@ -31,7 +31,7 @@ capture() {  # capture <name> <timeout> <cmd...>
 P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
 capture expand_r4b_k10 900 "${P[@]}" --expand shift shift_raw pack2
 capture expand_r4b_k10_dot 900 "${P[@]}" --expand shift shift_raw --refold dot
-capture expand_r4b_k64 900 "${P[@]}" --k 64 --expand shift shift_raw
+capture expand_r4b_k64 900 "${P[@]}" --k 64 --expand shift shift_raw pack2
 capture expand_r4b_k64_dot 900 "${P[@]}" --k 64 --expand shift shift_raw --refold dot
 # Decode shape: square coefficient matrix (p = k)
 capture expand_r4b_decode 900 "${P[@]}" --k 10 --p 10 --expand shift shift_raw pack2
