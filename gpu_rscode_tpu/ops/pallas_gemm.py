@@ -214,9 +214,15 @@ def _kernel_pack2(a_ref, b_ref, o_ref, *, w: int, k: int, p: int):
     planes = jnp.stack(
         [(v >> np.int32(s)) & np.int32(0x0101) for s in range(w)], axis=1
     ).reshape(k * w, tile2)
+    # Precision.HIGHEST is load-bearing on hardware: packed lanes take the
+    # value 257 (both fields set), which needs 9 significand bits — the
+    # MXU's default bf16 pass rounds it to 256, corrupting the low field
+    # (observed OracleMismatch, expand_r4b_k10_tpu_20260731T031556Z.jsonl).
+    # HIGHEST runs the multi-pass bf16 decomposition, exact for f32 inputs.
     acc = jnp.dot(
         a_ref[:], planes.astype(jnp.float32),
         preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
     )
     bits = acc.astype(jnp.int32) & 0x0101
     out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
@@ -277,10 +283,13 @@ def _kernel_body(
         # operand — Pallas kernels may not capture array constants).  The
         # VPU's per-output shift + w-way sum becomes one tiny bf16 matmul;
         # exact in f32 (values <= 2^w - 1 < 2^24).
+        # f32 -> int32 -> uint8/16: Mosaic refuses a direct f32 -> uint8
+        # cast (expand_r4b_k10_dot_tpu_20260731T031850Z.log); the int32 hop
+        # is the same cast chain the sum refold lowers with.
         o_ref[:] = jnp.dot(
             f_ref[:], bits.astype(jnp.bfloat16),
             preferred_element_type=jnp.float32,
-        ).astype(o_ref.dtype)
+        ).astype(jnp.int32).astype(o_ref.dtype)
         return
     out_shifts = jax.lax.broadcasted_iota(jnp.int32, (1, w, 1), 1)
     o_ref[:] = (
