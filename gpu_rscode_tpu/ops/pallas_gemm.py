@@ -43,16 +43,23 @@ end-to-end 64.3-64.8 GB/s at tile 16384
   analog of the reference's fastest kernel — the GF(16) nibble-table
   branch (design.tex:485 9.12 ms vs 160.5 ms; gf16.h:1-22).
 
-Hardware verdict (2026-07-30, real v5e, committed captures): ``"shift"`` is
-the production default — 64.3-64.6 GB/s, ~98 % of the measured compute-only
-ceiling.  ``"sign"`` and ``"nibble"`` do NOT lower on the current Mosaic
-toolchain (sign: ``arith.subi`` on int8 vectors fails to legalize; nibble:
-8-bit iota unsupported; reworked int32-iota formulations crash the compile
-helper) — see bench_captures/tile_pick_tpu_*.jsonl and
-bench_captures/expand_probe_tpu_*.jsonl.  Both remain available for
-interpret mode (bit-verified in CI) and for future toolchains; a packed
-uint8 mask-compare variant was also probed on hardware and measured slower
-than shift (40.7 vs 64.4 GB/s, same capture).
+Hardware verdict (2026-07-31, real v5e, committed captures
+bench_captures/expand_r4b_* / expand_r4c_*): the production default is
+``expand="shift_raw"`` + ``refold="dot"`` — the mask-free expansion beat
+``shift`` at every probed shape, and moving the parity refold onto the MXU
+beat the VPU shift-sum at every probed shape.  Headline (k=10, p=4):
+102.5 GB/s (was 64.7 under shift+sum); k=64: 132.0; k=128: 133.6; decode
+shape p=k=10: 80.5; w=16: 101.9.  ``"sign"`` and ``"nibble"`` do NOT
+lower on the current Mosaic toolchain (sign: ``arith.subi`` on int8
+vectors fails to legalize; nibble: 8-bit iota unsupported; reworked
+int32-iota formulations crash the compile helper) — see
+bench_captures/tile_pick_tpu_*.jsonl and expand_probe_tpu_*.jsonl.  They
+remain available for interpret mode (bit-verified in CI) and future
+toolchains.  Probed and rejected on measurement: a packed uint8
+mask-compare variant (40.7 vs 64.4 shift), and ``pack2`` — correct only
+under ``Precision.HIGHEST`` (packed lanes reach 257, which the default
+bf16 MXU pass rounds to 256) whose multi-pass cost sinks it to 2.4 GB/s
+(expand_r4b_decode capture).
 """
 
 from __future__ import annotations
@@ -393,14 +400,26 @@ def _pallas_matmul(
     )(*operands)
 
 
-def _fallback_to_shift(reason: str) -> str:
+def _default_expand(w: int, acc_dtype) -> str:
+    """The production default that APPLIES at this (w, acc_dtype):
+    shift_raw (faster at every probed shape — expand_r4b_*/expand_r4c_*
+    captures), except w=16 with an explicitly non-int8 accumulator, where
+    shift_raw's unmasked 16-bit planes would exceed bf16's exact-integer
+    range and the masked shift formulation is the production choice."""
+    if w == 16 and acc_dtype is not None and acc_dtype != jnp.int8:
+        return "shift"
+    return "shift_raw"
+
+
+def _fallback_expand(reason: str, to: str) -> str:
     """Env-selected modes keep the warn-and-fall-back guarantee: an env
     value that is unknown or inapplicable must neither crash production
-    nor silently record a capture under the wrong formulation."""
+    nor silently record a capture under a non-default formulation — the
+    fallback target is the production default that applies."""
     import warnings
 
-    warnings.warn(f"{reason}; using 'shift'", stacklevel=3)
-    return "shift"
+    warnings.warn(f"{reason}; using {to!r}", stacklevel=3)
+    return to
 
 
 def gf_matmul_pallas(
@@ -430,9 +449,11 @@ def gf_matmul_pallas(
     v5e captures (tile_pick_tpu_20260730T050344Z.jsonl,
     k_sweep_tpu_20260731T010808Z.jsonl); other widths keep the shallow
     defaults until a width-specific sweep is committed.
-    ``expand``: data-expansion formulation — "shift" (default) or
-    "shift_raw" (any width; w=16 needs acc_dtype=int8 — unmasked planes
-    exceed bf16's exact-integer range), "sign" (w=8/16), or the
+    ``expand``: data-expansion formulation — "shift_raw" (default; any
+    width, but w=16 needs acc_dtype=int8 — unmasked planes exceed bf16's
+    exact-integer range, so a w=16 call with an explicit non-int8
+    acc_dtype defaults to "shift" instead), "shift" (any width), "sign"
+    (w=8/16), or the
     byte-granular set "nibble"/"nibble_const"/"packed32"/"sign16"/
     "shift_u8"/"pack2" (w=8 only; the nibble pair one-hots against the
     (p*w, k*32) operator; see module docstring).  "pack2" additionally
@@ -444,9 +465,10 @@ def gf_matmul_pallas(
     module docstring's hardware verdict and bench_captures/expand_probe_*)
     and serve interpret mode.
     ``refold``: how the kernel folds accumulator parities back into GF
-    elements — "sum" (VPU: bits << s summed over w) or "dot" (MXU: one
-    tiny bf16 matmul against the (p, p*w) bit-weight operator; exact in
-    f32 for any supported w).  Env-overridable via RS_PALLAS_REFOLD.
+    elements — "dot" (default: MXU, one tiny bf16 matmul against the
+    (p, p*w) bit-weight operator; exact in f32 for any supported w) or
+    "sum" (VPU: bits << s summed over w).  Env-overridable via
+    RS_PALLAS_REFOLD.
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
@@ -465,16 +487,27 @@ def gf_matmul_pallas(
         # silently record a capture under the wrong formulation.
         import os
 
-        expand = os.environ.get("RS_PALLAS_EXPAND") or "shift"
-        from_env = expand != "shift"
-        applies = expand in _ANY_W + ("sign",) + _BYTE_ONLY and (
-            expand in _ANY_W or w == 8 or (w == 16 and expand == "sign")
-        )
-        if not applies:
-            expand = _fallback_to_shift(
-                f"RS_PALLAS_EXPAND={expand!r} is unknown or does not apply "
-                f"at w={w}"
+        env = os.environ.get("RS_PALLAS_EXPAND")
+        from_env = bool(env)
+        if from_env:
+            expand = env
+            applies = expand in _ANY_W + ("sign",) + _BYTE_ONLY and (
+                expand in _ANY_W or w == 8 or (w == 16 and expand == "sign")
             )
+            if not applies:
+                expand = _fallback_expand(
+                    f"RS_PALLAS_EXPAND={expand!r} is unknown or does not "
+                    f"apply at w={w}",
+                    _default_expand(w, acc_dtype),
+                )
+        else:
+            # The measured production default (shift_raw beat shift at
+            # every probed shape — expand_r4b_*/expand_r4c_* captures,
+            # 2026-07-31: k10 60.0 vs 44.1, k64 119.4 vs 100.5, p=k=10
+            # 48.4 vs 45.6, +dot k10 102.5 vs 82.8); at w=16 with an
+            # explicit non-int8 acc_dtype this silently selects "shift"
+            # rather than raise over a parameter the caller never passed.
+            expand = _default_expand(w, acc_dtype)
     if expand not in _ANY_W + ("sign",) + _BYTE_ONLY:
         raise ValueError(f"unknown expand {expand!r}")
     if expand == "sign" and w not in (8, 16):
@@ -495,8 +528,9 @@ def gf_matmul_pallas(
         # per-column bit-plane accumulators from_bitplanes expects.
         why = "pack2 cannot emit pre-parity accumulators"
         if from_env:
-            expand = _fallback_to_shift(
-                f"RS_PALLAS_EXPAND=pack2 does not apply here ({why})"
+            expand = _fallback_expand(
+                f"RS_PALLAS_EXPAND=pack2 does not apply here ({why})",
+                _default_expand(w, acc_dtype),
             )
         else:
             raise ValueError(why)
@@ -525,8 +559,9 @@ def gf_matmul_pallas(
         # and exact in bf16.)  Env-selected modes keep the warn-and-fall-
         # back guarantee instead of crashing production.
         if from_env:
-            expand = _fallback_to_shift(
-                "RS_PALLAS_EXPAND=shift_raw needs acc_dtype=int8 at w=16"
+            expand = _fallback_expand(
+                "RS_PALLAS_EXPAND=shift_raw needs acc_dtype=int8 at w=16",
+                _default_expand(w, acc_dtype),
             )
         else:
             raise ValueError(
@@ -565,15 +600,23 @@ def gf_matmul_pallas(
         # RS_PALLAS_EXPAND; an explicit refold argument always wins.
         import os
 
-        refold = os.environ.get("RS_PALLAS_REFOLD") or "sum"
+        # "dot" (MXU parity refold) is the measured production default:
+        # it lowers after the int32 cast-chain fix and wins at every
+        # probed shape — k64 132.0 vs 119.4, decode p=k=10 80.5 vs 48.4,
+        # headline k10 102.5 vs 60.0 (expand_r4b_*dot/expand_r4c_*dot
+        # captures, 2026-07-31).
+        refold = os.environ.get("RS_PALLAS_REFOLD") or "dot"
         if refold not in ("sum", "dot"):
             import warnings
 
+            # Fall back to the production default, matching the expand-side
+            # policy: an env typo must not silently record a capture under
+            # a non-default formulation.
             warnings.warn(
-                f"RS_PALLAS_REFOLD={refold!r} is unknown; using 'sum'",
+                f"RS_PALLAS_REFOLD={refold!r} is unknown; using 'dot'",
                 stacklevel=2,
             )
-            refold = "sum"
+            refold = "dot"
     if refold not in ("sum", "dot"):
         raise ValueError(f"unknown refold {refold!r}")
     return _pallas_matmul(

@@ -242,7 +242,9 @@ def _spy_matmul(monkeypatch, seen, force_interpret=False):
 
 def test_refold_env_override(monkeypatch):
     """RS_PALLAS_REFOLD routes the default refold for whole-pipeline
-    experiments; unknown values warn and fall back to 'sum'."""
+    experiments; unknown values warn and fall back to the production
+    default 'dot' (an env typo must not silently switch the run off the
+    default formulation)."""
     seen = []
     _spy_matmul(monkeypatch, seen)
     gf = get_field(8)
@@ -250,15 +252,51 @@ def test_refold_env_override(monkeypatch):
     A = rng.integers(0, 256, size=(2, 4), dtype=np.uint8)
     B = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
     want = gf.matmul(A, B)
-    monkeypatch.setenv("RS_PALLAS_REFOLD", "dot")
+    monkeypatch.setenv("RS_PALLAS_REFOLD", "sum")
     np.testing.assert_array_equal(np.asarray(gf_matmul_pallas(A, B)), want)
-    assert seen[-1]["refold"] == "dot"
+    assert seen[-1]["refold"] == "sum"
     monkeypatch.setenv("RS_PALLAS_REFOLD", "bogus")
     with pytest.warns(UserWarning, match="RS_PALLAS_REFOLD"):
         np.testing.assert_array_equal(
             np.asarray(gf_matmul_pallas(A, B)), want
         )
-    assert seen[-1]["refold"] == "sum"
+    assert seen[-1]["refold"] == "dot"
+
+
+def test_production_defaults(monkeypatch):
+    """The measured production defaults (expand_r4b_*/expand_r4c_*
+    captures): expand='shift_raw' + refold='dot'; at w=16 an explicit
+    non-int8 acc_dtype silently selects the masked 'shift' formulation
+    (shift_raw would need int8 there, which the caller overrode)."""
+    seen = []
+    _spy_matmul(monkeypatch, seen)
+    monkeypatch.delenv("RS_PALLAS_EXPAND", raising=False)
+    monkeypatch.delenv("RS_PALLAS_REFOLD", raising=False)
+    gf = get_field(8)
+    rng = np.random.default_rng(31)
+    A = rng.integers(0, 256, size=(2, 4), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(gf_matmul_pallas(A, B)), gf.matmul(A, B)
+    )
+    assert seen[-1]["expand"] == "shift_raw"
+    assert seen[-1]["refold"] == "dot"
+    gf16 = get_field(16)
+    A16 = rng.integers(0, 1 << 16, size=(2, 4), dtype=np.uint16)
+    B16 = rng.integers(0, 1 << 16, size=(4, 512), dtype=np.uint16)
+    want16 = gf16.matmul(A16, B16)
+    np.testing.assert_array_equal(
+        np.asarray(gf_matmul_pallas(A16, B16, w=16)), want16
+    )
+    assert seen[-1]["expand"] == "shift_raw"
+    assert seen[-1]["acc_dtype"] == jnp.int8
+    np.testing.assert_array_equal(
+        np.asarray(
+            gf_matmul_pallas(A16, B16, w=16, acc_dtype=jnp.bfloat16)
+        ),
+        want16,
+    )
+    assert seen[-1]["expand"] == "shift"
 
 
 def test_depth_aware_tpu_defaults(monkeypatch):
@@ -295,10 +333,12 @@ def test_depth_aware_tpu_defaults(monkeypatch):
 
 def test_expand_env_default(monkeypatch):
     """RS_PALLAS_EXPAND overrides the default formulation for whole-pipeline
-    experiments; unknown/inapplicable values warn and fall back to shift,
-    and an explicit expand= argument always wins.  The formulation actually
-    reaching the kernel is spied on — every expansion is bit-identical, so
-    output equality alone cannot prove the env var was honored."""
+    experiments; unknown/inapplicable values warn and fall back to the
+    production default that applies (shift_raw; shift at w=16 with an
+    explicit non-int8 acc), and an explicit expand= argument always wins.
+    The formulation actually reaching the kernel is spied on — every
+    expansion is bit-identical, so output equality alone cannot prove the
+    env var was honored."""
     seen = []
     _spy_matmul(monkeypatch, seen)
     rng = np.random.default_rng(3)
@@ -309,20 +349,20 @@ def test_expand_env_default(monkeypatch):
     got = np.asarray(gf_matmul_pallas(A, B))  # env default applies (w=8)
     np.testing.assert_array_equal(got, want)
     assert seen[-1]["expand"] == "packed32"
-    # w=16 cannot run a byte-granular strategy: env warns, falls to shift.
+    # w=16 cannot run a byte-granular strategy: env warns, falls back.
     A16 = rng.integers(0, 1 << 16, size=(2, 4), dtype=np.uint16)
     B16 = rng.integers(0, 1 << 16, size=(4, 512), dtype=np.uint16)
     want16 = get_field(16).matmul(A16, B16)
     with pytest.warns(UserWarning, match="does not apply"):
         got16 = np.asarray(gf_matmul_pallas(A16, B16, w=16))
     np.testing.assert_array_equal(got16, want16)
-    assert seen[-1]["expand"] == "shift"
+    assert seen[-1]["expand"] == "shift_raw"
     # an env typo warns and falls back instead of crashing production
     monkeypatch.setenv("RS_PALLAS_EXPAND", "packed_32")
     with pytest.warns(UserWarning, match="unknown"):
         got2 = np.asarray(gf_matmul_pallas(A, B))
     np.testing.assert_array_equal(got2, want)
-    assert seen[-1]["expand"] == "shift"
+    assert seen[-1]["expand"] == "shift_raw"
     # explicit argument wins over the env var (no warning, no fallback)
     monkeypatch.setenv("RS_PALLAS_EXPAND", "nonsense")
     got3 = np.asarray(gf_matmul_pallas(A, B, expand="sign"))

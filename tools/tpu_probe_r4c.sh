@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-4c follow-up: the capture points the r4b set could not deliver.
+#
+# The r4b session (expand_r4b_* captures, 2026-07-31) proved shift_raw >
+# shift at every probed shape and, after the mid-session cast fix, that
+# refold="dot" lowers and wins (k64: 132.0 vs 119.4; decode p=k=10:
+# 80.5 vs 48.4).  The k=10 HEADLINE point with refold=dot failed pre-fix
+# (f32->uint8 cast), and the wide-symbol (w=16) path has no
+# shift_raw/dot capture yet.  pack2 has its verdict (correct after the
+# Precision.HIGHEST fix, but 2.39 GB/s — the multi-pass MXU cost kills
+# it; expand_r4b_decode capture) and is not re-probed.
+# Commits after every capture — same convention as tpu_probe_r4b.sh.
+set -u
+cd /root/repo
+mkdir -p bench_captures
+START=$SECONDS
+
+capture() {  # capture <name> <timeout> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  local ts
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  local out="bench_captures/${name}_tpu_${ts}.jsonl"
+  echo "# [$((SECONDS - START))s] capturing ${name} (timeout ${tmo}s)" >&2
+  timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
+  local rc=$?
+  echo "# ${name} rc=${rc}" >&2
+  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
+  if [ -s "$out" ]; then
+    git add "$out" "${out%.jsonl}.log" 2>/dev/null
+    git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
+  else
+    rm -f "$out"
+  fi
+  return $rc
+}
+
+P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
+capture expand_r4c_k10_dot 900 "${P[@]}" --expand shift shift_raw --refold dot
+capture expand_r4c_k128_dot 900 "${P[@]}" --k 128 --expand shift_raw --refold dot
+W16=(python -m gpu_rscode_tpu.tools.w16_bench --trials 3)
+capture w16_raw 900 env RS_PALLAS_EXPAND=shift_raw "${W16[@]}"
+capture w16_raw_dot 900 env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=dot "${W16[@]}"
+echo "# round-4c probe set complete" >&2
