@@ -45,11 +45,15 @@ end-to-end 64.3-64.8 GB/s at tile 16384
 
 Hardware verdict (2026-07-31, real v5e, committed captures
 bench_captures/expand_r4b_* / expand_r4c_*): the production default is
-``expand="shift_raw"`` + ``refold="dot"`` — the mask-free expansion beat
-``shift`` at every probed shape, and moving the parity refold onto the MXU
-beat the VPU shift-sum at every probed shape.  Headline (k=10, p=4):
-102.5 GB/s (was 64.7 under shift+sum); k=64: 132.0; k=128: 133.6; decode
-shape p=k=10: 80.5; w=16: 101.9.  ``"sign"`` and ``"nibble"`` do NOT
+``expand="shift_raw"`` plus, at w=8, ``refold="dot"`` — the mask-free
+expansion beat ``shift`` at every probed shape, and moving the parity
+refold onto the MXU beat the VPU shift-sum at every probed w=8 shape.
+Headline (k=10, p=4): 102.5 GB/s (was 64.7 under shift+sum); k=64: 132.0;
+k=128: 133.6; decode shape p=k=10: 80.5.  w=16 measured 101.9 under
+shift_raw (was 90.3 under shift), but its refold there is "sum": the one
+w=16+dot attempt died at the capture timeout with the tunnel wedging
+right after (hang vs tunnel unresolved — tools/tpu_probe_r4d.sh
+re-probes), so w!=8 keeps the sum refold.  ``"sign"`` and ``"nibble"`` do NOT
 lower on the current Mosaic toolchain (sign: ``arith.subi`` on int8
 vectors fails to legalize; nibble: 8-bit iota unsupported; reworked
 int32-iota formulations crash the compile helper) — see
@@ -459,16 +463,18 @@ def gf_matmul_pallas(
     (p*w, k*32) operator; see module docstring).  "pack2" additionally
     requires fold_parity=True and runs a fixed f32/packed-refold pipeline
     (passing acc_dtype or refold with it raises); contractions deeper than
-    k*w < 256 split into carry-free depth slices XORed together.  On the current TPU toolchain only "shift"/"shift_raw"
-    (and, pending a capture, "pack2" — it avoids every previously refused
-    op) lower to hardware — the rest fail Mosaic legalization (see the
-    module docstring's hardware verdict and bench_captures/expand_probe_*)
-    and serve interpret mode.
+    k*w < 256 split into carry-free depth slices XORed together.  On the
+    current TPU toolchain only "shift"/"shift_raw"/"pack2" lower to
+    hardware — pack2 correctly only under Precision.HIGHEST, whose cost
+    sinks it to 2.4 GB/s (rejected; see module docstring) — the rest fail
+    Mosaic legalization (bench_captures/expand_probe_*) and serve
+    interpret mode.
     ``refold``: how the kernel folds accumulator parities back into GF
-    elements — "dot" (default: MXU, one tiny bf16 matmul against the
-    (p, p*w) bit-weight operator; exact in f32 for any supported w) or
-    "sum" (VPU: bits << s summed over w).  Env-overridable via
-    RS_PALLAS_REFOLD.
+    elements — "dot" (MXU: one tiny bf16 matmul against the (p, p*w)
+    bit-weight operator; exact in f32 for any supported w) or "sum"
+    (VPU: bits << s summed over w).  Default: "dot" at w=8 (the width
+    the captures validate), "sum" elsewhere until a width-specific
+    capture lands.  Env-overridable via RS_PALLAS_REFOLD.
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
@@ -482,9 +488,10 @@ def gf_matmul_pallas(
         # experiments (e.g. RS_PALLAS_EXPAND=packed32 python bench.py)
         # without touching call sites; the literal default only changes
         # with a committed capture justifying it.  An env value that is
-        # unknown or inapplicable at this width falls back to shift WITH
-        # a warning — an env typo must neither crash production nor
-        # silently record a capture under the wrong formulation.
+        # unknown or inapplicable at this width falls back WITH a warning
+        # to the production default that applies (_default_expand) — an
+        # env typo must neither crash production nor silently record a
+        # capture under a non-default formulation.
         import os
 
         env = os.environ.get("RS_PALLAS_EXPAND")
@@ -600,12 +607,18 @@ def gf_matmul_pallas(
         # RS_PALLAS_EXPAND; an explicit refold argument always wins.
         import os
 
-        # "dot" (MXU parity refold) is the measured production default:
-        # it lowers after the int32 cast-chain fix and wins at every
-        # probed shape — k64 132.0 vs 119.4, decode p=k=10 80.5 vs 48.4,
-        # headline k10 102.5 vs 60.0 (expand_r4b_*dot/expand_r4c_*dot
-        # captures, 2026-07-31).
-        refold = os.environ.get("RS_PALLAS_REFOLD") or "dot"
+        # "dot" (MXU parity refold) is the measured production default at
+        # w=8: it lowers after the int32 cast-chain fix and wins at every
+        # probed w=8 shape — k64 132.0 vs 119.4, decode p=k=10 80.5 vs
+        # 48.4, headline k10 102.5 vs 60.0 (expand_r4b_*dot/
+        # expand_r4c_*dot captures, 2026-07-31).  Other widths stay on
+        # "sum" until a width-specific capture lands: the only w=16+dot
+        # hardware attempt (r4c w16_raw_dot) died at the 900 s timeout
+        # with the tunnel wedging right after — hang-vs-tunnel unresolved,
+        # and an unvalidated default that can hang must not ship
+        # (tools/tpu_probe_r4d.sh re-probes it).
+        default_refold = "dot" if w == 8 else "sum"
+        refold = os.environ.get("RS_PALLAS_REFOLD") or default_refold
         if refold not in ("sum", "dot"):
             import warnings
 
@@ -613,10 +626,11 @@ def gf_matmul_pallas(
             # policy: an env typo must not silently record a capture under
             # a non-default formulation.
             warnings.warn(
-                f"RS_PALLAS_REFOLD={refold!r} is unknown; using 'dot'",
+                f"RS_PALLAS_REFOLD={refold!r} is unknown; "
+                f"using {default_refold!r}",
                 stacklevel=2,
             )
-            refold = "dot"
+            refold = default_refold
     if refold not in ("sum", "dot"):
         raise ValueError(f"unknown refold {refold!r}")
     return _pallas_matmul(

@@ -265,9 +265,11 @@ def test_refold_env_override(monkeypatch):
 
 def test_production_defaults(monkeypatch):
     """The measured production defaults (expand_r4b_*/expand_r4c_*
-    captures): expand='shift_raw' + refold='dot'; at w=16 an explicit
-    non-int8 acc_dtype silently selects the masked 'shift' formulation
-    (shift_raw would need int8 there, which the caller overrode)."""
+    captures): expand='shift_raw' + refold='dot' at w=8; w=16 keeps
+    refold='sum' (its only dot hardware attempt never completed), and an
+    explicit non-int8 acc_dtype there silently selects the masked
+    'shift' formulation (shift_raw would need int8, which the caller
+    overrode)."""
     seen = []
     _spy_matmul(monkeypatch, seen)
     monkeypatch.delenv("RS_PALLAS_EXPAND", raising=False)
@@ -290,6 +292,7 @@ def test_production_defaults(monkeypatch):
     )
     assert seen[-1]["expand"] == "shift_raw"
     assert seen[-1]["acc_dtype"] == jnp.int8
+    assert seen[-1]["refold"] == "sum"
     np.testing.assert_array_equal(
         np.asarray(
             gf_matmul_pallas(A16, B16, w=16, acc_dtype=jnp.bfloat16)

@@ -1,0 +1,29 @@
+# Shared capture helper for the TPU probe/watch scripts — source from a
+# script that has set START=$SECONDS and cd'd to the repo root.
+#
+#   capture <name> <timeout_s> <cmd...>
+#
+# Runs <cmd> under timeout, writes stdout to
+# bench_captures/<name>_tpu_<utc>.jsonl and stderr to the matching .log,
+# '#'-prefixes any non-JSON stdout lines (commented-jsonl convention),
+# and git-commits the pair immediately — the tunnel can wedge at any
+# moment, so every capture must be durable the instant it exists.
+# Empty captures are removed, not committed.
+capture() {
+  local name=$1 tmo=$2; shift 2
+  local ts
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  local out="bench_captures/${name}_tpu_${ts}.jsonl"
+  echo "# [$((SECONDS - START))s] capturing ${name} (timeout ${tmo}s)" >&2
+  timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
+  local rc=$?
+  echo "# ${name} rc=${rc}" >&2
+  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
+  if [ -s "$out" ]; then
+    git add "$out" "${out%.jsonl}.log" 2>/dev/null
+    git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
+  else
+    rm -f "$out"
+  fi
+  return $rc
+}

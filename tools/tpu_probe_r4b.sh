@@ -9,24 +9,7 @@ cd /root/repo
 mkdir -p bench_captures
 START=$SECONDS
 
-capture() {  # capture <name> <timeout> <cmd...>
-  local name=$1 tmo=$2; shift 2
-  local ts
-  ts=$(date -u +%Y%m%dT%H%M%SZ)
-  local out="bench_captures/${name}_tpu_${ts}.jsonl"
-  echo "# [$((SECONDS - START))s] capturing ${name} (timeout ${tmo}s)" >&2
-  timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
-  local rc=$?
-  echo "# ${name} rc=${rc}" >&2
-  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
-  if [ -s "$out" ]; then
-    git add "$out" "${out%.jsonl}.log" 2>/dev/null
-    git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
-  else
-    rm -f "$out"
-  fi
-  return $rc
-}
+. "$(dirname "$0")/capture_lib.sh"
 
 P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
 capture expand_r4b_k10 900 "${P[@]}" --expand shift shift_raw pack2

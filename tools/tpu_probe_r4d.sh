@@ -1,0 +1,81 @@
+#!/bin/bash
+# Round-4d: the post-default-flip evidence set.  Watches the axon tunnel
+# (wedged again after the r4c w16_raw capture, 2026-07-31 ~03:45); on the
+# first healthy probe it captures, committing after every capture:
+#   1. bench.py headline — the driver-identical artifact under the new
+#      shift_raw + dot production defaults (expected ~100 GB/s vs the
+#      61.9 recorded pre-flip).
+#   2. w16 with explicit refold=sum — baseline for (3).
+#   3. w16 with explicit refold=dot LAST — the r4c w16_raw_dot capture
+#      died at the 900 s timeout with the tunnel wedging right after, so
+#      hang-vs-tunnel is unresolved; if this combo genuinely hangs the
+#      w16 default must not be dot.
+# Usage: tools/tpu_probe_r4d.sh [max_seconds]
+set -u
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+
+capture() {  # capture <name> <timeout> <cmd...>
+  local name=$1 tmo=$2; shift 2
+  local ts
+  ts=$(date -u +%Y%m%dT%H%M%SZ)
+  local out="bench_captures/${name}_tpu_${ts}.jsonl"
+  echo "# [$((SECONDS - START))s] capturing ${name} (timeout ${tmo}s)" >&2
+  timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
+  local rc=$?
+  echo "# ${name} rc=${rc}" >&2
+  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
+  if [ -s "$out" ]; then
+    git add "$out" "${out%.jsonl}.log" 2>/dev/null
+    git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
+  else
+    rm -f "$out"
+  fi
+  return $rc
+}
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; starting round-4d capture set" >&2
+
+    ts=$(date -u +%Y%m%dT%H%M%SZ)
+    timeout 900 python bench.py \
+      > "bench_captures/bench_${ts}.json" 2> "bench_captures/bench_${ts}.log"
+    brc=$?
+    echo "# bench rc=${brc}" >&2
+    if [ -s "bench_captures/bench_${ts}.json" ] \
+        && grep -q '_tpu"' "bench_captures/bench_${ts}.json"; then
+      mv "bench_captures/bench_${ts}.json" \
+         "bench_captures/bench_tpu_${ts}.json"
+      git add "bench_captures/bench_tpu_${ts}.json" \
+              "bench_captures/bench_${ts}.log"
+      git commit -q -m "TPU capture: headline bench, post-flip defaults"
+    else
+      git add "bench_captures/bench_${ts}.json" \
+              "bench_captures/bench_${ts}.log" 2>/dev/null
+      git commit -q -m "bench capture attempt (rc=${brc}, no TPU line)" \
+        2>/dev/null
+    fi
+
+    W16=(python -m gpu_rscode_tpu.tools.w16_bench --trials 3)
+    capture w16_raw_sum 900 \
+      env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=sum "${W16[@]}"
+    capture w16_raw_dot2 900 \
+      env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=dot "${W16[@]}"
+    echo "# round-4d capture set complete" >&2
+    exit 0
+  fi
+  sleep 60
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
