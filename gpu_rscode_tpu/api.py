@@ -72,6 +72,14 @@ class ChunkIntegrityError(ValueError):
 # per in-flight segment (k rows x seg_cols bytes).
 DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
 
+# Fleet repair routes batched survivor inversions to the device only up to
+# this k on TPU backends: the v5e capture shows the vmapped Gauss-Jordan
+# winning at k <= 32 (sequential pivot scan still amortized by the batch)
+# and losing at k = 128 (bench_captures/inverse_tpu_20260731T032339Z.jsonl;
+# crossover between 32 and 128 unmeasured, so the threshold sits at the
+# last measured win).
+_DEVICE_INVERT_MAX_K_TPU = 32
+
 
 def _segment_cols(chunk_size: int, native_num: int, segment_bytes: int) -> int:
     cols = max(1, segment_bytes // max(1, native_num))
@@ -1737,8 +1745,24 @@ def repair_fleet(
             continue
         groups.setdefault((s.k, s.w), []).append(f)
     with timer.phase("invert matrices (batched)"):
+        from .utils.backend import tpu_devices_present
+
         for (k, w), group in groups.items():
             gf = get_field(w)
+            if tpu_devices_present() and k > _DEVICE_INVERT_MAX_K_TPU:
+                # Measured routing (bench_captures/inverse_tpu_20260731T*):
+                # on a real v5e the batched device inverter wins at
+                # k <= 32 with large batches (up to 3.0x) but LOSES at
+                # k = 128 (0.56-0.67x — the sequential pivot scan
+                # dominates at depth k), so deep configs take the host
+                # path.  On CPU backends the batched dispatch wins at
+                # every measured k (14-136x, inverse_cpu_20260730T*).
+                for f in group:
+                    try:
+                        chosen_inv[f] = _select_decodable_subset(scans[f])
+                    except ValueError as e:
+                        errors[f] = str(e)
+                continue
             subs = [
                 scans[f].total_mat[scans[f].healthy[:k]].astype(gf.dtype)
                 for f in group

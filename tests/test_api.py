@@ -874,6 +874,41 @@ def test_repair_fleet_batched_inversion(tmp_path):
             ), f"{path} chunk {i}"
 
 
+def test_repair_fleet_deep_k_routes_to_host_on_tpu(tmp_path, monkeypatch):
+    """Measured routing (bench_captures/inverse_tpu_20260731T*): on TPU
+    backends the batched device inverter loses above k=32, so deep-k
+    groups take the per-archive host path instead of the device batch."""
+    from gpu_rscode_tpu.ops import inverse as inverse_mod
+    from gpu_rscode_tpu.utils import backend as backend_mod
+    import gpu_rscode_tpu.api as api_mod
+
+    path = _mkfile(tmp_path, 5000, seed=77)
+    api.encode_file(path, 4, 2, checksums=True)
+    golden = {
+        i: open(chunk_file_name(path, i), "rb").read() for i in range(6)
+    }
+    os.remove(chunk_file_name(path, 1))
+
+    # Pretend this is a TPU backend with the threshold below k=4, but keep
+    # the GEMM on the CPU-safe bitplane strategy (the interpret gate is
+    # pallas-only, so tpu_devices_present=True must not reach a compile).
+    monkeypatch.setattr(backend_mod, "tpu_devices_present", lambda: True)
+    monkeypatch.setattr(api_mod, "_DEVICE_INVERT_MAX_K_TPU", 2)
+
+    def forbidden_batch(Ms, w=8):
+        raise AssertionError(
+            "device batch dispatched for a deep-k group on a TPU backend"
+        )
+
+    monkeypatch.setattr(
+        inverse_mod, "invert_matrix_jax_batch", forbidden_batch
+    )
+    results = api.repair_fleet([path], strategy="bitplane")
+    assert results == {path: [1]}
+    for i in range(6):
+        assert open(chunk_file_name(path, i), "rb").read() == golden[i]
+
+
 def test_repair_fleet_all_or_nothing(tmp_path):
     """An unrecoverable archive anywhere in the fleet aborts the whole pass
     before any rebuild is written."""

@@ -5,11 +5,12 @@
 # the decode shape (p=k).  Commits after every capture — same convention
 # as tpu_capture_r4.sh.  Run only when the tunnel is otherwise idle.
 set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
 cd /root/repo
 mkdir -p bench_captures
 START=$SECONDS
 
-. "$(dirname "$0")/capture_lib.sh"
+. "$LIB"
 
 P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
 capture expand_r4b_k10 900 "${P[@]}" --expand shift shift_raw pack2

@@ -11,11 +11,12 @@
 # it; expand_r4b_decode capture) and is not re-probed.
 # Commits after every capture — same convention as tpu_probe_r4b.sh.
 set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
 cd /root/repo
 mkdir -p bench_captures
 START=$SECONDS
 
-. "$(dirname "$0")/capture_lib.sh"
+. "$LIB"
 
 P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
 capture expand_r4c_k10_dot 900 "${P[@]}" --expand shift shift_raw --refold dot

@@ -9,33 +9,17 @@
 #   3. w16 with explicit refold=dot LAST — the r4c w16_raw_dot capture
 #      died at the 900 s timeout with the tunnel wedging right after, so
 #      hang-vs-tunnel is unresolved; if this combo genuinely hangs the
-#      w16 default must not be dot.
+#      w16 default must not be dot (and pallas_gemm.py keeps "sum" there
+#      until this capture lands).
 # Usage: tools/tpu_probe_r4d.sh [max_seconds]
 set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
 cd /root/repo
 mkdir -p bench_captures
 MAX=${1:-36000}
 START=$SECONDS
 ATTEMPT=0
-
-capture() {  # capture <name> <timeout> <cmd...>
-  local name=$1 tmo=$2; shift 2
-  local ts
-  ts=$(date -u +%Y%m%dT%H%M%SZ)
-  local out="bench_captures/${name}_tpu_${ts}.jsonl"
-  echo "# [$((SECONDS - START))s] capturing ${name} (timeout ${tmo}s)" >&2
-  timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
-  local rc=$?
-  echo "# ${name} rc=${rc}" >&2
-  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
-  if [ -s "$out" ]; then
-    git add "$out" "${out%.jsonl}.log" 2>/dev/null
-    git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
-  else
-    rm -f "$out"
-  fi
-  return $rc
-}
+. "$LIB"
 
 while [ $((SECONDS - START)) -lt "$MAX" ]; do
   ATTEMPT=$((ATTEMPT + 1))
@@ -55,10 +39,14 @@ EOF
     echo "# bench rc=${brc}" >&2
     if [ -s "bench_captures/bench_${ts}.json" ] \
         && grep -q '_tpu"' "bench_captures/bench_${ts}.json"; then
+      # Keep the <stem>.json/<stem>.log pairing when promoting to the
+      # bench_tpu_ prefix bench.py globs for.
       mv "bench_captures/bench_${ts}.json" \
          "bench_captures/bench_tpu_${ts}.json"
+      mv "bench_captures/bench_${ts}.log" \
+         "bench_captures/bench_tpu_${ts}.log"
       git add "bench_captures/bench_tpu_${ts}.json" \
-              "bench_captures/bench_${ts}.log"
+              "bench_captures/bench_tpu_${ts}.log"
       git commit -q -m "TPU capture: headline bench, post-flip defaults"
     else
       git add "bench_captures/bench_${ts}.json" \
