@@ -193,6 +193,27 @@ def _expand_shift_u8(b, w, k, tile):
     return jnp.stack(planes, axis=1).reshape(k * w, tile)
 
 
+def _expand_nibble32(b, w, k, tile):
+    # The nibble one-hot (the reference's fastest-kernel idea, gf16.h:1-22)
+    # carried entirely in int32 lanes — the only lane width the Mosaic
+    # toolchain has lowered for this kernel (r3/r4 verdicts: every 8/16-bit
+    # formulation fails legalization or crashes the compile helper).
+    # 32 compares per input byte on the VPU buy a 4x-deeper MXU
+    # contraction against the (p*w, k*32) one-hot operator; the k-sweep
+    # capture shows deep contractions RAISE throughput, so the trade is
+    # plausible where compares are cheaper than shifts.  Compare constants
+    # are python-unrolled scalar immediates (no iota).
+    v = b.astype(jnp.int32)
+    hi = v[:, None, :] >> np.int32(4)
+    lo = v[:, None, :] & np.int32(15)
+    planes = jnp.concatenate(
+        [hi == np.int32(c) for c in range(16)]
+        + [lo == np.int32(c) for c in range(16)],
+        axis=1,
+    )  # (k, 32, tile) bool
+    return planes.reshape(k * 32, tile)
+
+
 def _expand_nibble_const(b, w, k, tile):
     # The nibble one-hot (reference's fastest-kernel idea, gf16.h:1-22)
     # with the 16 compare values python-unrolled as scalar immediates
@@ -271,6 +292,7 @@ def _kernel_body(
         "sign16": _expand_sign16,
         "shift_u8": _expand_shift_u8,
         "nibble_const": _expand_nibble_const,
+        "nibble32": _expand_nibble32,
     }[expand]
     planes = expander(b_ref[:], w, k, tile)
     acc = jnp.dot(
@@ -358,7 +380,7 @@ def _pallas_matmul(
     # cpu-rs-double.c:52-55).
     from .gemm import expand_bitmatrix_jnp, expand_nibblematrix_jnp
 
-    if expand in ("nibble", "nibble_const"):
+    if expand in ("nibble", "nibble_const", "nibble32"):
         a_op = expand_nibblematrix_jnp(A, w)
         a_cols = k * 32
     else:
@@ -458,16 +480,18 @@ def gf_matmul_pallas(
     exact-integer range, so a w=16 call with an explicit non-int8
     acc_dtype defaults to "shift" instead), "shift" (any width), "sign"
     (w=8/16), or the
-    byte-granular set "nibble"/"nibble_const"/"packed32"/"sign16"/
-    "shift_u8"/"pack2" (w=8 only; the nibble pair one-hots against the
-    (p*w, k*32) operator; see module docstring).  "pack2" additionally
+    byte-granular set "nibble"/"nibble_const"/"nibble32"/"packed32"/
+    "sign16"/"shift_u8"/"pack2" (w=8 only; the nibble family one-hots
+    against the (p*w, k*32) operator; see module docstring).  "pack2" additionally
     requires fold_parity=True and runs a fixed f32/packed-refold pipeline
     (passing acc_dtype or refold with it raises); contractions deeper than
     k*w < 256 split into carry-free depth slices XORed together.  On the
     current TPU toolchain only "shift"/"shift_raw"/"pack2" lower to
     hardware — pack2 correctly only under Precision.HIGHEST, whose cost
-    sinks it to 2.4 GB/s (rejected; see module docstring) — the rest fail
-    Mosaic legalization (bench_captures/expand_probe_*) and serve
+    sinks it to 2.4 GB/s (rejected; see module docstring).  "nibble32"
+    (the nibble one-hot in int32 lanes, the lowerable lane width) awaits
+    its hardware verdict (tools/tpu_probe_r4e.sh); the remaining modes
+    fail Mosaic legalization (bench_captures/expand_probe_*) and serve
     interpret mode.
     ``refold``: how the kernel folds accumulator parities back into GF
     elements — "dot" (MXU: one tiny bf16 matmul against the (p, p*w)
@@ -479,7 +503,8 @@ def gf_matmul_pallas(
     the CPU test mesh.
     """
     _BYTE_ONLY = (
-        "nibble", "nibble_const", "packed32", "sign16", "shift_u8", "pack2",
+        "nibble", "nibble_const", "nibble32", "packed32", "sign16",
+        "shift_u8", "pack2",
     )
     _ANY_W = ("shift", "shift_raw")
     from_env = False

@@ -61,7 +61,7 @@ def main() -> int:
     ap.add_argument(
         "--expand", nargs="+",
         default=["shift", "shift_raw", "pack2", "packed32", "sign16",
-                 "shift_u8", "nibble_const", "sign", "nibble"],
+                 "shift_u8", "nibble_const", "nibble32", "sign", "nibble"],
     )
     args = ap.parse_args()
 

@@ -71,7 +71,8 @@ def test_pallas_file_roundtrip(tmp_path):
 @pytest.mark.parametrize(
     "expand",
     ["shift", "shift_raw", "sign", "nibble",
-     "packed32", "sign16", "shift_u8", "nibble_const", "pack2"],  # r4 set
+     "packed32", "sign16", "shift_u8", "nibble_const", "nibble32",
+     "pack2"],  # r4 set
 )
 def test_pallas_expand_modes(expand):
     """All data-expansion formulations are bit-exact (the sign trick's
@@ -97,7 +98,7 @@ def test_pallas_nibble_rejects_wide_field():
 @pytest.mark.parametrize(
     "expand",
     ["shift", "shift_raw", "sign", "nibble",
-     "packed32", "sign16", "shift_u8", "nibble_const"],
+     "packed32", "sign16", "shift_u8", "nibble_const", "nibble32"],
 )
 def test_pallas_preparity_expand_modes(expand):
     """fold_parity=False (the stripe-sharded pre-psum form) under every

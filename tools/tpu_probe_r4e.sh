@@ -1,0 +1,57 @@
+#!/bin/bash
+# Round-4e: post-flip tuning + the nibble32 candidate.  Waits for any
+# running r4d set to finish (one tunnel client at a time), then on the
+# first healthy probe:
+#   1. nibble32 verdict at k=10 — the reference's nibble-table idea
+#      (gf16.h:1-22) carried entirely in int32 lanes, the only lane width
+#      this Mosaic toolchain lowers; every narrower nibble attempt failed
+#      legalization (r3/r4 captures).
+#   2. tile x acc micro-sweep at the headline shape under shift_raw+dot —
+#      the TPU_TILE=16384/int8-below-depth-256 defaults were measured
+#      under shift+sum and may have moved with the refold off the VPU.
+#   3. k_sweep rerun under the new production defaults (the committed
+#      depth rule DEEP_CONTRACTION=256 was a sum-refold measurement).
+# Usage: tools/tpu_probe_r4e.sh [max_seconds]
+set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+. "$LIB"
+
+while pgrep -f "tpu_probe_r4d.sh" >/dev/null 2>&1; do
+  echo "# waiting for r4d to finish t=$((SECONDS - START))s" >&2
+  sleep 60
+  [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
+done
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; starting round-4e capture set" >&2
+    P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
+    capture nibble32_k10 900 "${P[@]}" --expand shift_raw nibble32
+    capture nibble32_k10_dot 900 "${P[@]}" --expand shift_raw nibble32 \
+      --refold dot
+    for tile in 8192 16384 32768 65536; do
+      for acc in int8 bf16; do
+        capture "tile_dot_k10_t${tile}_${acc}" 600 "${P[@]}" \
+          --expand shift_raw --refold dot --tile "$tile" --acc "$acc"
+      done
+    done
+    capture k_sweep_postflip 1800 python -m gpu_rscode_tpu.tools.k_sweep
+    echo "# round-4e capture set complete" >&2
+    exit 0
+  fi
+  sleep 60
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
