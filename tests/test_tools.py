@@ -58,3 +58,32 @@ def test_inverse_bench_smoke():
     row = got[0]
     assert row["k"] == 8 and row["batch"] == 16
     assert row["invertible"] > 0 and row["device_dispatch_s"] > 0
+
+
+def test_capture_scripts_are_valid_bash():
+    """A capture script with a syntax error would burn an entire healthy
+    tunnel window producing nothing — reject it in CI instead.  Also pins
+    the shared-lib contract: every probe script sources capture_lib.sh
+    (one copy of the capture convention) with a path resolved BEFORE any
+    cd, so relative invocations work."""
+    import pathlib
+    import subprocess
+
+    tools_dir = pathlib.Path(__file__).resolve().parent.parent / "tools"
+    scripts = sorted(tools_dir.glob("*.sh"))
+    assert scripts, tools_dir
+    for s in scripts:
+        proc = subprocess.run(
+            ["bash", "-n", str(s)], capture_output=True, text=True,
+            timeout=30,
+        )
+        assert proc.returncode == 0, f"{s.name}: {proc.stderr}"
+    probes = sorted(tools_dir.glob("tpu_probe_*.sh"))
+    assert probes, tools_dir
+    lib_idiom = 'LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"'
+    for p in probes:
+        src = p.read_text()
+        assert lib_idiom in src and '. "$LIB"' in src, (
+            f"{p.name}: must resolve capture_lib.sh from its own location "
+            f"(before any cd) and source it"
+        )
