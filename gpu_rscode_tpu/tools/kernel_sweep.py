@@ -77,7 +77,7 @@ def _body_dma(a_ref, b_ref, o_ref, *, w, k, p):
 
 # The sign/nibble expanders are the production ones — the sweep must
 # benchmark the exact formulations that ship.
-from ..ops.pallas_gemm import _expand_nibble, _expand_sign
+from ..ops.pallas_gemm import _expand_nibble, _expand_shift_raw, _expand_sign
 
 
 def _body_sign(a_ref, b_ref, o_ref, *, w, k, p):
@@ -119,6 +119,28 @@ def _body_signf(a_ref, b_ref, o_ref, *, w, k, p):
     o_ref[:] = out.astype(o_ref.dtype)
 
 
+def _body_raw_dot(a_ref, b_ref, o_ref, *, w, k, p):
+    """The round-4 production formulation (pallas_gemm defaults since
+    2026-07-31): mask-free shift_raw expansion + MXU dot refold.  The
+    (p, p*w) fold operator is built from iota ops in-kernel (Pallas
+    kernels may not capture array constants; the production kernel passes
+    it as an operand instead) and the output takes the f32 -> int32 ->
+    uint8 chain Mosaic lowers (a direct f32 -> uint8 cast is refused)."""
+    tile = b_ref.shape[-1]
+    planes = _expand_shift_raw(b_ref[:], w, k, tile)
+    acc = jnp.dot(
+        a_ref[:], planes.astype(jnp.int8), preferred_element_type=jnp.int32
+    )
+    bits = (acc & 1).astype(jnp.bfloat16)
+    r = jax.lax.broadcasted_iota(jnp.int32, (p, p * w), 0)
+    c = jax.lax.broadcasted_iota(jnp.int32, (p, p * w), 1)
+    F = jnp.where(
+        c // w == r, jnp.left_shift(1, c % w), 0
+    ).astype(jnp.bfloat16)
+    out = jnp.dot(F, bits, preferred_element_type=jnp.float32)
+    o_ref[:] = out.astype(jnp.int32).astype(o_ref.dtype)
+
+
 def _body_nibble(a_ref, b_ref, o_ref, *, w, k, p):
     """One-hot nibble expansion against the (p*w, k*32) operator — the MXU
     analog of the reference's GF(16) nibble-table kernel (design.tex:485)."""
@@ -142,6 +164,7 @@ BODIES = {
     "signc": _body_signc,
     "signf": _body_signf,
     "nibble": _body_nibble,
+    "raw_dot": _body_raw_dot,
 }
 
 # Bodies whose coefficient operator is the (p*w, k*32) one-hot-nibble form
@@ -181,7 +204,7 @@ def main():
         "--tiles", type=str, default="8192,16384,32768,65536"
     )
     ap.add_argument(
-        "--bodies", type=str, default="base,cmp,sign,signc,signf,nibble",
+        "--bodies", type=str, default="base,cmp,sign,signc,signf,nibble,raw_dot",
         help="comma-separated subset of kernel bodies to sweep",
     )
     args = ap.parse_args()
@@ -237,8 +260,11 @@ def main():
         return max(vals, default=0.0)
 
     best_tile = max(tiles, key=_tile_best)
-    for name, pinned in (("dma", False), ("base", True)):
-        key = "dma_floor" if name == "dma" else "compute_only"
+    # The compute-only ceiling is measured on the production body when the
+    # sweep includes it (raw_dot since round 4), else on "base".
+    ceiling_body = "raw_dot" if "raw_dot" in bodies else "base"
+    for name, pinned in (("dma", False), (ceiling_body, True)):
+        key = "dma_floor" if name == "dma" else f"compute_only[{name}]"
         try:
             fn = make_fn(name, A_bits, Bd, best_tile, pinned_input=pinned)
             dt = _time(fn, trials=args.trials)

@@ -1,0 +1,43 @@
+#!/bin/bash
+# Round-4f: re-measure the kernel floors under the round-4 production
+# formulation.  The committed compute-only ceiling (64.9 GB/s,
+# kernel_floors_tpu_20260730T*) was measured on the OLD shift+sum body;
+# the shipping kernel is now shift_raw + dot refold at 102.5 GB/s — past
+# the old ceiling — so "X % of ceiling" claims need a fresh floor.
+# Waits for r4d/r4e (one tunnel client at a time).
+# Usage: tools/tpu_probe_r4f.sh [max_seconds]
+set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+. "$LIB"
+
+while pgrep -f "tpu_probe_r4[de].sh" >/dev/null 2>&1; do
+  echo "# waiting for r4d/r4e to finish t=$((SECONDS - START))s" >&2
+  sleep 60
+  [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
+done
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; starting round-4f capture set" >&2
+    capture kernel_floors_postflip 1200 \
+      python -m gpu_rscode_tpu.tools.kernel_sweep \
+      --mb 320 --trials 3 --bodies base,raw_dot --tiles 16384,32768
+    echo "# round-4f capture set complete" >&2
+    exit 0
+  fi
+  sleep 60
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
