@@ -77,8 +77,22 @@ DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
 # winning at k <= 32 (sequential pivot scan still amortized by the batch)
 # and losing at k = 128 (bench_captures/inverse_tpu_20260731T032339Z.jsonl;
 # crossover between 32 and 128 unmeasured, so the threshold sits at the
-# last measured win).
+# last measured win).  Round 5 switches the device dispatch to the
+# scan-free no-pivot elimination (ops.inverse, pivot=False), which removes
+# the per-step argmax/permutation that capture blamed for the k=128 loss —
+# the threshold stays until the r5 inverse_nopivot capture re-measures it
+# (tools/tpu_probe_r5.sh).
 _DEVICE_INVERT_MAX_K_TPU = 32
+
+# The same v5e capture shows the device dispatch losing at SMALL batches
+# for every k (0.2x at k=10/batch=64, 0.77x at k=10/batch=256; it wins
+# near batch ~1024) — and a typical scrub finds few damaged archives per
+# (k, w) group, so small groups take the host path.  Same policy as the k
+# threshold: sit at the LAST MEASURED WIN (batch 1024) until the r5
+# capture measures the 256..1024 crossover (tools/tpu_probe_r5.sh probes
+# batch 16/64/256/1024).  CPU backends keep the ungated device dispatch
+# (14-136x at every measured point, inverse_cpu_20260730T174508Z.jsonl).
+_DEVICE_INVERT_MIN_BATCH_TPU = 1024
 
 
 def _segment_cols(chunk_size: int, native_num: int, segment_bytes: int) -> int:
@@ -1705,7 +1719,7 @@ def repair_fleet(
     Returns ``{file: [rebuilt chunk indices]}`` ([] for healthy archives).
     """
     from .ops.gf import get_field
-    from .ops.inverse import invert_matrix_jax_batch
+    from .ops.inverse import invert_matrix_jax_batch, mds_nopivot_order
 
     timer = timer or PhaseTimer(enabled=False)
     files = list(files)
@@ -1749,25 +1763,40 @@ def repair_fleet(
 
         for (k, w), group in groups.items():
             gf = get_field(w)
-            if tpu_devices_present() and k > _DEVICE_INVERT_MAX_K_TPU:
+            if tpu_devices_present() and (
+                k > _DEVICE_INVERT_MAX_K_TPU
+                or len(group) < _DEVICE_INVERT_MIN_BATCH_TPU
+            ):
                 # Measured routing (bench_captures/inverse_tpu_20260731T*):
-                # on a real v5e the batched device inverter wins at
-                # k <= 32 with large batches (up to 3.0x) but LOSES at
-                # k = 128 (0.56-0.67x — the sequential pivot scan
-                # dominates at depth k), so deep configs take the host
-                # path.  On CPU backends the batched dispatch wins at
-                # every measured k (14-136x, inverse_cpu_20260730T*).
+                # on a real v5e the batched device inverter wins only at
+                # k <= 32 AND large batches (up to 3.0x near batch 1024);
+                # it loses at k = 128 (0.56-0.67x — the sequential pivot
+                # scan) and at small batches for every k (0.2x at
+                # batch=64), so deep configs and small groups take the
+                # host path.  On CPU backends the batched dispatch wins at
+                # every measured point (14-136x, inverse_cpu_20260730T*).
                 for f in group:
                     try:
                         chosen_inv[f] = _select_decodable_subset(scans[f])
                     except ValueError as e:
                         errors[f] = str(e)
                 continue
+            # Scan-free elimination (pivot=False): with each surviving
+            # native's identity row placed AT its own position
+            # (mds_nopivot_order), pivoting is only ever needed inside the
+            # tiny parity Schur complement — rare, flagged by ok=False,
+            # and re-solved through the host search below.  Every inverse
+            # is verified before use either way, so dropping the
+            # sequential per-step argmax/permutation (the measured k=128
+            # loss, inverse_tpu_20260731T032339Z.jsonl) is safe.
+            ordered = {
+                f: mds_nopivot_order(scans[f].healthy[:k], k) for f in group
+            }
             subs = [
-                scans[f].total_mat[scans[f].healthy[:k]].astype(gf.dtype)
+                scans[f].total_mat[ordered[f]].astype(gf.dtype)
                 for f in group
             ]
-            invs, oks = invert_matrix_jax_batch(np.stack(subs), w)
+            invs, oks = invert_matrix_jax_batch(np.stack(subs), w, pivot=False)
             invs = np.asarray(invs).astype(gf.dtype)
             oks = np.asarray(oks)
             eye = np.eye(k, dtype=gf.dtype)
@@ -1776,7 +1805,7 @@ def repair_fleet(
                     gf.matmul(subs[j], invs[j]), eye
                 )
                 if verified:
-                    chosen_inv[f] = (scans[f].healthy[:k], invs[j])
+                    chosen_inv[f] = (ordered[f], invs[j])
                     continue
                 # Singular first candidate (or a device-inverse mismatch —
                 # never observed, but a wrong inverse must not write wrong

@@ -95,7 +95,45 @@ def _invert_jax(M: jnp.ndarray, w: int) -> tuple[jnp.ndarray, jnp.ndarray]:
     return A[:, k:], ok
 
 
+def _invert_jax_nopivot(M: jnp.ndarray, w: int) -> tuple[jnp.ndarray, jnp.ndarray]:
+    # Gauss-Jordan WITHOUT the row-pivot scan: the per-iteration
+    # argmax + whole-matrix permutation gather in :func:`_invert_jax` is the
+    # sequential bottleneck the v5e capture blamed for the k=128 device loss
+    # (0.56-0.67x vs host, bench_captures/inverse_tpu_20260731T032339Z.jsonl).
+    # Pivot-free elimination is exact iff every leading principal minor is
+    # nonsingular — true in practice for the MDS survivor submatrices this
+    # path inverts (Vandermonde/Cauchy row subsets; the reference's own
+    # production inverter assumes the same and its pivot fallback is buggy,
+    # cpu-decode.c:131-135).  ``ok`` goes False on any zero diagonal pivot
+    # (gf_inv maps 0 -> 0 branchlessly, so the loop stays finite and the
+    # garbage result is discarded); callers verify with one GF matmul and
+    # fall back to the pivoting path — repair_fleet already carries exactly
+    # that verify-and-fallback structure.
+    log, exp = tables(w)
+    k = M.shape[0]
+
+    def gmul(a, b):
+        return exp[log[a] + log[b]]
+
+    A = jnp.concatenate([M.astype(jnp.int32), jnp.eye(k, dtype=jnp.int32)], axis=1)
+    rows = jnp.arange(k)
+
+    def body(i, carry):
+        A, ok = carry
+        pivot = A[i, i]
+        ok = ok & (pivot != 0)
+        row_i = gmul(A[i], gf_inv(pivot, w))
+        A = A.at[i].set(row_i)
+        elim = gmul(A[:, i][:, None], row_i[None, :])
+        elim = jnp.where((rows == i)[:, None], 0, elim)
+        return A ^ elim, ok
+
+    A, ok = jax.lax.fori_loop(0, k, body, (A, jnp.bool_(True)))
+    return A[:, k:], ok
+
+
 _invert_jax_jit = jax.jit(_invert_jax, static_argnums=1)
+_invert_nopivot_jit = jax.jit(_invert_jax_nopivot, static_argnums=1)
 
 
 def invert_matrix_jax(M, w: int = 8):
@@ -110,12 +148,63 @@ def invert_matrix_jax(M, w: int = 8):
     return _invert_jax_jit(jnp.asarray(M), w)
 
 
+def mds_nopivot_order(rows, k: int) -> list:
+    """Reorder a k-row survivor subset so pivot-free elimination succeeds
+    for the systematic layout.
+
+    A survivor subset in chunk-index order stacks identity rows OFF their
+    diagonal positions whenever a native is missing (lose chunk 0 and the
+    subset starts with e_1, so M[0,0] = 0 — the elimination dies at step
+    0).  Placing surviving native r (the identity row e_r) at position r
+    and filling the missing-native positions with the parity rows makes
+    every identity pivot 1, and the elimination only ever needs pivoting
+    inside the e x e parity Schur complement (e = missing natives, tiny) —
+    where a zero leading minor is rare and caught by the ``ok`` flag +
+    verify-and-fallback.  Measured at k=32 (Vandermonde-mod-256 total
+    matrix): 0/40 failures for realistic e <= 4 subsets; ~15 % ok=False for
+    adversarial half-parity subsets (which then re-solve via the pivoting
+    path).  For the Cauchy generator the Schur complement is itself a
+    Cauchy submatrix, whose leading minors are Cauchy determinants — all
+    nonzero — so no-pivot never fails there.  Row order of a survivor
+    subset is free: the inverse just has to be paired with chunks stacked
+    in the same order.
+    """
+    rows = list(rows)
+    out: list = [None] * len(rows)
+    parities = []
+    for r in rows:
+        if r < k:
+            out[r] = r
+        else:
+            parities.append(r)
+    free = iter(i for i, v in enumerate(out) if v is None)
+    for r in parities:
+        out[next(free)] = r
+    return out
+
+
+def invert_matrix_jax_nopivot(M, w: int = 8):
+    """On-device Gauss-Jordan inverse WITHOUT row pivoting.
+
+    Returns ``(inverse int32 (k, k), ok bool)``; ``ok`` is False when a
+    diagonal pivot vanished — which for a nonsingular matrix means the
+    elimination hit an unlucky leading minor and the caller must retry with
+    :func:`invert_matrix_jax` (or the host inverter).  Callers are expected
+    to verify the inverse (one GF matmul) regardless, the discipline
+    ``api.repair_fleet`` already applies to every device inverse.
+    """
+    return _invert_nopivot_jit(jnp.asarray(M), w)
+
+
 _invert_batch_jit = jax.jit(
     jax.vmap(_invert_jax, in_axes=(0, None)), static_argnums=1
 )
+_invert_batch_nopivot_jit = jax.jit(
+    jax.vmap(_invert_jax_nopivot, in_axes=(0, None)), static_argnums=1
+)
 
 
-def invert_matrix_jax_batch(Ms, w: int = 8):
+def invert_matrix_jax_batch(Ms, w: int = 8, *, pivot: bool = True):
     """Batched on-device inverse: (b, k, k) -> ((b, k, k) int32, (b,) ok).
 
     The practical realisation of the direction the reference's blocked-GPU
@@ -124,5 +213,15 @@ def invert_matrix_jax_batch(Ms, w: int = 8):
     occurs in storage systems, where each stripe of an object may have lost
     a different chunk subset and needs its own k x k inverse.  One dispatch
     inverts thousands of decode matrices.
+
+    ``pivot=False`` runs the scan-free elimination (:func:`_invert_jax_nopivot`)
+    — no per-step argmax/permutation, the sequential cost that made the
+    pivoting version LOSE to the host loop at k=128 on v5e
+    (inverse_tpu_20260731T032339Z.jsonl).  ``ok`` additionally goes False on
+    any zero diagonal pivot; since MDS survivor submatrices essentially
+    never produce one, the intended production pattern is
+    no-pivot first, verify each inverse, re-solve the rare failures with
+    the pivoting/host path.
     """
-    return _invert_batch_jit(jnp.asarray(Ms), w)
+    jit = _invert_batch_jit if pivot else _invert_batch_nopivot_jit
+    return jit(jnp.asarray(Ms), w)

@@ -5,9 +5,13 @@ in one vmapped device dispatch (``ops.inverse.invert_matrix_jax_batch``, the
 production reincarnation of the reference's dormant GPU inverter
 matrix.cu:667-744 / blocked experiment decode-gj.cu:1059-1201).  This tool
 measures that amortisation: B random invertible k x k GF(2^8) survivor
-submatrices inverted (a) on device in one dispatch, (b) on host one
-``invert_matrix`` call at a time — the two paths repair_fleet chooses
-between.
+submatrices inverted (a) on device in one dispatch — pivoting and
+(round 5) scan-free no-pivot variants, (b) on host one ``invert_matrix``
+call at a time — the paths repair_fleet chooses between.  The no-pivot
+variant drops the per-step argmax + permutation gather that made the
+pivoting dispatch LOSE to the host loop at k=128 on v5e
+(inverse_tpu_20260731T032339Z.jsonl); this tool's captures set or retire
+api._DEVICE_INVERT_MAX_K_TPU from measurement.
 
 Usage: python -m gpu_rscode_tpu.tools.inverse_bench [--batch 256] [--k 32]
 Prints one JSON line per (batch, k) combination (commented-jsonl capture
@@ -33,7 +37,11 @@ def main() -> int:
 
     from ..models.vandermonde import total_matrix
     from ..ops.gf import get_field
-    from ..ops.inverse import invert_matrix, invert_matrix_jax_batch
+    from ..ops.inverse import (
+        invert_matrix,
+        invert_matrix_jax_batch,
+        mds_nopivot_order,
+    )
     from ..utils.backend import backend_label
 
     import jax
@@ -49,20 +57,38 @@ def main() -> int:
         T = total_matrix(k, k, gf)
         n = 2 * k
         for batch in args.batch:
+            # The production arrangement (repair_fleet): surviving-native
+            # identity rows at their own positions so the no-pivot variant
+            # measures the shape it actually dispatches on.
             subs = np.stack([
-                T[np.sort(rng.choice(n, size=k, replace=False))]
+                T[mds_nopivot_order(
+                    np.sort(rng.choice(n, size=k, replace=False)), k
+                )]
                 for _ in range(batch)
             ])
             dev_subs = jax.device_put(subs)
 
-            def run():
-                invs, oks = invert_matrix_jax_batch(dev_subs, 8)
+            def run(pivot=True):
+                invs, oks = invert_matrix_jax_batch(dev_subs, 8, pivot=pivot)
                 return jax.block_until_ready(invs), np.asarray(oks)
 
             invs, oks = run()  # warmup/compile
             dev_best = min(
                 _timed(run) for _ in range(args.trials)
             )
+
+            invs_np, oks_np = run(pivot=False)  # warmup/compile
+            nopivot_best = min(
+                _timed(lambda: run(pivot=False)) for _ in range(args.trials)
+            )
+            # The no-pivot result must agree with the pivoting one wherever
+            # it claims success (it may flag extra ok=False on unlucky
+            # leading minors; none expected for MDS subsets).
+            agree = np.flatnonzero(np.asarray(oks_np))
+            for j in agree[:4]:
+                assert np.array_equal(
+                    np.asarray(invs_np[j]), np.asarray(invs[j])
+                ), f"no-pivot inverse mismatch at {j}"
 
             ok_idx = np.flatnonzero(oks)
             t0 = time.perf_counter()
@@ -84,11 +110,17 @@ def main() -> int:
                 "k": k,
                 "batch": batch,
                 "invertible": int(len(ok_idx)),
+                "nopivot_ok": int(len(agree)),
                 "device_dispatch_s": round(dev_best, 6),
+                "nopivot_dispatch_s": round(nopivot_best, 6),
                 "device_per_matrix_us": round(1e6 * dev_best / batch, 2),
+                "nopivot_per_matrix_us": round(1e6 * nopivot_best / batch, 2),
                 "host_per_matrix_us": round(1e6 * host_per, 2),
                 "speedup_vs_host_loop": round(
                     host_per * batch / dev_best, 2
+                ),
+                "nopivot_speedup_vs_host_loop": round(
+                    host_per * batch / nopivot_best, 2
                 ),
             }), flush=True)
     return 0

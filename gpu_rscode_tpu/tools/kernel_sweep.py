@@ -262,6 +262,9 @@ def main():
     best_tile = max(tiles, key=_tile_best)
     # The compute-only ceiling is measured on the production body when the
     # sweep includes it (raw_dot since round 4), else on "base".
+    # Key naming: r1-r3 captures (kernel_floors_tpu_20260730T*) used plain
+    # "compute_only" for what is now "compute_only[base]"; readers comparing
+    # against old captures must map the legacy key to the [base] body.
     ceiling_body = "raw_dot" if "raw_dot" in bodies else "base"
     for name, pinned in (("dma", False), (ceiling_body, True)):
         key = "dma_floor" if name == "dma" else f"compute_only[{name}]"

@@ -850,9 +850,9 @@ def test_repair_fleet_batched_inversion(tmp_path):
     calls = []
     real_batch = inverse_mod.invert_matrix_jax_batch
 
-    def counting_batch(Ms, w=8):
+    def counting_batch(Ms, w=8, **kw):
         calls.append(np.asarray(Ms).shape)
-        return real_batch(Ms, w)
+        return real_batch(Ms, w, **kw)
 
     import gpu_rscode_tpu.api as api_mod
     old = inverse_mod.invert_matrix_jax_batch
@@ -907,6 +907,61 @@ def test_repair_fleet_deep_k_routes_to_host_on_tpu(tmp_path, monkeypatch):
     assert results == {path: [1]}
     for i in range(6):
         assert open(chunk_file_name(path, i), "rb").read() == golden[i]
+
+
+def test_repair_fleet_small_batch_routes_to_host_on_tpu(tmp_path, monkeypatch):
+    """Measured routing (ADVICE r4 / inverse_tpu_20260731T*): the device
+    dispatch loses at small batches for every k (0.2x at batch=64), and a
+    typical scrub damages few archives per (k, w) group — so groups below
+    _DEVICE_INVERT_MIN_BATCH_TPU take the host path on TPU backends."""
+    from gpu_rscode_tpu.ops import inverse as inverse_mod
+    from gpu_rscode_tpu.utils import backend as backend_mod
+    import gpu_rscode_tpu.api as api_mod
+
+    path = _mkfile(tmp_path, 5000, seed=78)
+    api.encode_file(path, 4, 2, checksums=True)
+    os.remove(chunk_file_name(path, 1))
+
+    monkeypatch.setattr(backend_mod, "tpu_devices_present", lambda: True)
+    assert api_mod._DEVICE_INVERT_MAX_K_TPU >= 4  # k passes; batch gates
+
+    def forbidden_batch(Ms, w=8, **kw):
+        raise AssertionError(
+            "device batch dispatched for a 1-archive group on a TPU backend"
+        )
+
+    monkeypatch.setattr(
+        inverse_mod, "invert_matrix_jax_batch", forbidden_batch
+    )
+    assert api.repair_fleet([path], strategy="bitplane") == {path: [1]}
+
+
+def test_repair_fleet_device_batch_uses_nopivot(tmp_path, monkeypatch):
+    """When the device batch IS dispatched it must run the scan-free
+    elimination (pivot=False) — the verify-and-fallback below it makes that
+    safe, and the pivot scan is the measured k=128 loss."""
+    from gpu_rscode_tpu.ops import inverse as inverse_mod
+
+    paths = []
+    for s in range(2):
+        p = _mkfile(tmp_path, 3000 + s, seed=90 + s)
+        api.encode_file(p, 4, 2, checksums=True)
+        os.remove(chunk_file_name(p, 1))
+        paths.append(p)
+
+    seen = {}
+    real = inverse_mod.invert_matrix_jax_batch
+
+    def spy(Ms, w=8, *, pivot=True):
+        seen["pivot"] = pivot
+        return real(Ms, w, pivot=pivot)
+
+    # repair_fleet imports the symbol at call time from ops.inverse, so
+    # patching the module attribute intercepts the production dispatch.
+    monkeypatch.setattr(inverse_mod, "invert_matrix_jax_batch", spy)
+    results = api.repair_fleet(paths, strategy="bitplane")
+    assert results == {p: [1] for p in paths}
+    assert seen["pivot"] is False
 
 
 def test_repair_fleet_all_or_nothing(tmp_path):

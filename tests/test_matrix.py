@@ -108,6 +108,85 @@ def test_invert_jax_singular_flag():
     assert not bool(ok)
 
 
+@pytest.mark.parametrize("k", [2, 4, 10, 32])
+def test_invert_jax_nopivot_matches_host(k):
+    """Scan-free elimination agrees with the host inverter on MDS survivor
+    submatrices in the production arrangement (mds_nopivot_order — each
+    surviving native's identity row at its own position, repair_fleet's
+    device-dispatch shape)."""
+    from gpu_rscode_tpu.ops.inverse import (
+        invert_matrix_jax_nopivot,
+        mds_nopivot_order,
+    )
+
+    rng = np.random.default_rng(200 + k)
+    T = total_matrix(k, k)
+    # Realistic damage: e <= 4 missing natives, e parity substitutes (a
+    # storage stripe loses a few chunks, not half of them).  Measured on
+    # 40 such subsets per k: the ordered no-pivot elimination never hits a
+    # zero pivot; exotic half-parity subsets can (~15 % at k=32) and take
+    # the documented ok=False fallback instead.
+    e = min(4, k // 2) or 1
+    missing = set(rng.choice(k, size=e, replace=False).tolist())
+    surv = [i for i in range(k) if i not in missing]
+    pars = sorted(int(k + K) for K in rng.choice(k, size=e, replace=False))
+    rows = mds_nopivot_order(surv + pars, k)
+    sub = T[rows]
+    want = invert_matrix(sub)
+    got, ok = invert_matrix_jax_nopivot(sub)
+    assert bool(ok)
+    np.testing.assert_array_equal(np.asarray(got, dtype=np.uint8), want)
+
+
+def test_mds_nopivot_order_places_natives_on_diagonal():
+    from gpu_rscode_tpu.ops.inverse import mds_nopivot_order
+
+    # k=6, natives 1,3,4 survive, parities 6,8,9 fill positions 0,2,5.
+    out = mds_nopivot_order([1, 3, 4, 6, 8, 9], 6)
+    assert out == [6, 1, 8, 3, 4, 9]
+    # All-natives and all-parity edge cases.
+    assert mds_nopivot_order([0, 1, 2], 3) == [0, 1, 2]
+    assert mds_nopivot_order([3, 4, 5], 3) == [3, 4, 5]
+
+
+def test_invert_jax_nopivot_flags_zero_leading_minor():
+    """An invertible matrix whose elimination hits a zero diagonal pivot
+    must come back ok=False (the caller's verify-and-fallback re-solves it
+    via the pivoting path) — not a wrong inverse."""
+    from gpu_rscode_tpu.ops.inverse import invert_matrix_jax_nopivot
+
+    M = np.array([[0, 1], [1, 0]], dtype=np.uint8)  # invertible, M[0,0]=0
+    _, ok = invert_matrix_jax_nopivot(M)
+    assert not bool(ok)
+    # The pivoting variant solves it.
+    got, ok2 = invert_matrix_jax(M)
+    assert bool(ok2)
+    np.testing.assert_array_equal(
+        GF.matmul(np.asarray(got), M), np.eye(2, dtype=np.uint8)
+    )
+
+
+def test_invert_jax_batch_nopivot():
+    from gpu_rscode_tpu.ops.inverse import (
+        invert_matrix_jax_batch,
+        mds_nopivot_order,
+    )
+
+    rng = np.random.default_rng(7)
+    k = 6
+    T = total_matrix(k, k)
+    subs = np.stack([
+        T[mds_nopivot_order(
+            np.sort(rng.choice(2 * k, size=k, replace=False)), k
+        )]
+        for _ in range(16)
+    ])
+    invs, oks = invert_matrix_jax_batch(subs, 8, pivot=False)
+    invs_p, oks_p = invert_matrix_jax_batch(subs, 8, pivot=True)
+    assert np.asarray(oks).all() and np.asarray(oks_p).all()
+    np.testing.assert_array_equal(np.asarray(invs), np.asarray(invs_p))
+
+
 def test_cauchy_all_submatrices_invertible():
     k, p = 4, 3
     T = np.concatenate([np.eye(k, dtype=np.uint8), cauchy_matrix(p, k)], axis=0)

@@ -87,3 +87,13 @@ def test_capture_scripts_are_valid_bash():
             f"{p.name}: must resolve capture_lib.sh from its own location "
             f"(before any cd) and source it"
         )
+    # ONE copy of the capture convention exists (ADVICE r4): no script may
+    # define its own capture()/capture_bench() — they source the lib.
+    for s in scripts:
+        if s.name == "capture_lib.sh":
+            continue
+        src = s.read_text()
+        assert "capture() {" not in src and "capture_bench() {" not in src, (
+            f"{s.name}: defines a private copy of the capture convention; "
+            f"source tools/capture_lib.sh instead"
+        )

@@ -12,32 +12,13 @@
 #   6. inverse_bench                (item 7: batched-inversion win)
 # Usage: tools/tpu_capture_r4.sh [max_seconds]
 set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
 cd /root/repo
 mkdir -p bench_captures
 MAX=${1:-36000}
 START=$SECONDS
 ATTEMPT=0
-
-capture() {  # capture <name> <timeout> <cmd...>
-  local name=$1 tmo=$2; shift 2
-  local ts
-  ts=$(date -u +%Y%m%dT%H%M%SZ)
-  local out="bench_captures/${name}_tpu_${ts}.jsonl"
-  echo "# [$((SECONDS - START))s] capturing ${name} (timeout ${tmo}s)" >&2
-  timeout "$tmo" "$@" > "$out" 2> "${out%.jsonl}.log"
-  local rc=$?
-  echo "# ${name} rc=${rc}" >&2
-  # commented-jsonl convention: '#'-prefix any human-readable lines a tool
-  # printed to stdout (e.g. stream_bench phase summaries)
-  sed -i -e '/^[{#]/!s/^/# /' "$out" 2>/dev/null
-  if [ -s "$out" ]; then
-    git add "$out" "${out%.jsonl}.log" 2>/dev/null
-    git commit -q -m "TPU capture: ${name} (rc=${rc})" 2>/dev/null
-  else
-    rm -f "$out"
-  fi
-  return $rc
-}
+. "$LIB"
 
 while [ $((SECONDS - START)) -lt "$MAX" ]; do
   ATTEMPT=$((ATTEMPT + 1))
@@ -50,20 +31,8 @@ EOF
   then
     echo "# tunnel healthy; starting round-4 capture set" >&2
 
-    # 1. headline bench (bench_tpu_ prefix is what bench.py globs for)
-    ts=$(date -u +%Y%m%dT%H%M%SZ)
-    timeout 900 python bench.py \
-      > "bench_captures/bench_${ts}.json" 2> "bench_captures/bench_${ts}.log"
-    brc=$?
-    if [ $brc -eq 0 ] && grep -q '_tpu"' "bench_captures/bench_${ts}.json"; then
-      mv "bench_captures/bench_${ts}.json" "bench_captures/bench_tpu_${ts}.json"
-      git add "bench_captures/bench_tpu_${ts}.json" "bench_captures/bench_${ts}.log"
-      git commit -q -m "TPU capture: headline bench"
-      echo "# bench capture OK" >&2
-    else
-      echo "# bench rc=$brc without TPU line; continuing with the tool set" >&2
-      rm -f "bench_captures/bench_${ts}.json"
-    fi
+    # 1. headline bench (promotion convention lives in capture_lib.sh)
+    capture_bench 900
 
     capture expand_probe 1800 python -m gpu_rscode_tpu.tools.expand_probe
     capture k_sweep 2400 python -m gpu_rscode_tpu.tools.k_sweep

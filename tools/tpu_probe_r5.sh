@@ -1,0 +1,100 @@
+#!/bin/bash
+# Round-5 capture set, in VERDICT-r4 priority order.  Waits for any
+# round-4 watcher still armed (one tunnel client at a time), then on the
+# first healthy probe captures, committing after EVERY capture:
+#   1. bench.py headline — the driver-identical artifact under the
+#      shift_raw+dot production defaults (VERDICT r4 task 1: the round
+#      artifact has carried a CPU fallback for four rounds).
+#   2. mesh_bench — fused kernel under shard_map on a real-chip mesh
+#      (task 2: cols + stripe-psum + the pre-parity kernel form; a Mosaic
+#      refusal propagates and the committed log is the deliverable).
+#   3. kernel floors under shift_raw+dot (task 3: the 102.5 GB/s headline
+#      is past the OLD 64.9 compute ceiling; optimization is blind
+#      without a fresh floor).
+#   4. w16 refold disambiguation at SMALL shape + SHORT timeout (task 4:
+#      the one w16+dot attempt died at a 900 s timeout with the tunnel
+#      wedging right after; a 240 s small-shape run separates hang from
+#      tunnel quickly and cheaply).  sum first (baseline), dot last.
+#   5. inverse_bench --pivot both (task 5: the no-pivot batched inverse
+#      vs the pivoting one vs the host loop, k in {10,32,64,128} — sets
+#      or retires _DEVICE_INVERT_MAX_K_TPU from measurement).
+#   6. nibble32 verdict + tile x acc micro-sweep at the headline shape
+#      (task 3 follow-ups, inherited from the r4e watcher).
+#   7. k_sweep rerun under the new defaults.
+# Usage: tools/tpu_probe_r5.sh [max_seconds]
+set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-40000}
+START=$SECONDS
+ATTEMPT=0
+. "$LIB"
+
+while pgrep -f "tpu_probe_r4[a-f].sh" >/dev/null 2>&1; do
+  echo "# waiting for round-4 watchers to finish t=$((SECONDS - START))s" >&2
+  sleep 60
+  [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
+done
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; starting round-5 capture set" >&2
+
+    # 1. Headline bench (promotion convention lives in capture_lib.sh).
+    capture_bench 900
+
+    # 2. shard_map lowering proof on the real chip.
+    capture mesh_pallas 900 \
+      python -m gpu_rscode_tpu.tools.mesh_bench --mb 320 --trials 3
+
+    # 3. Post-flip kernel floors (the r4f payload).
+    capture kernel_floors_postflip 1200 \
+      python -m gpu_rscode_tpu.tools.kernel_sweep \
+      --mb 320 --trials 3 --bodies base,raw_dot --tiles 16384,32768
+
+    # 4. w16 hang disambiguation: tiny shape, short timeout, sum first.
+    W16S=(python -m gpu_rscode_tpu.tools.w16_bench --mb 32 --trials 1)
+    capture w16_small_sum 240 \
+      env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=sum "${W16S[@]}"
+    capture w16_small_dot 240 \
+      env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=dot "${W16S[@]}"
+    # Full-shape dot only if the small-shape run survived (rc!=124).
+    if [ $? -ne 124 ]; then
+      capture w16_raw_dot_full 900 \
+        env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=dot \
+        python -m gpu_rscode_tpu.tools.w16_bench --trials 3
+    fi
+
+    # 5. Batched-inversion routing: pivot vs no-pivot vs host across k.
+    capture inverse_nopivot 900 \
+      python -m gpu_rscode_tpu.tools.inverse_bench \
+      --k 10 32 64 128 --batch 16 64 256 1024
+
+    # 6. nibble32 verdict + tile/acc micro-sweep (the r4e payload).
+    P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3)
+    capture nibble32_k10 900 "${P[@]}" --expand shift_raw nibble32
+    for tile in 16384 32768; do
+      for acc in int8 bf16; do
+        capture "tile_dot_k10_t${tile}_${acc}" 600 "${P[@]}" \
+          --expand shift_raw --refold dot --tile "$tile" --acc "$acc"
+      done
+    done
+
+    # 7. k-sweep under the production defaults.
+    capture k_sweep_postflip 1800 python -m gpu_rscode_tpu.tools.k_sweep
+
+    echo "# round-5 capture set complete" >&2
+    exit 0
+  fi
+  sleep 60
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
