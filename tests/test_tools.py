@@ -58,6 +58,31 @@ def test_inverse_bench_smoke():
     row = got[0]
     assert row["k"] == 8 and row["batch"] == 16
     assert row["invertible"] > 0 and row["device_dispatch_s"] > 0
+    # Round 5: the scan-free variant is measured alongside, and must agree
+    # with the pivoting dispatch wherever it claims success.
+    assert row["nopivot_dispatch_s"] > 0 and row["nopivot_ok"] > 0
+
+
+def test_mesh_bench_smoke():
+    got = _run_tool(
+        "gpu_rscode_tpu.tools.mesh_bench", "--mb", "2", "--trials", "1",
+    )
+    summary = got[-1]
+    res = summary["results"]
+    # On the CPU mesh every mode runs interpret/XLA and must bit-verify.
+    assert all(isinstance(res[m], float) for m in
+               ("cols_pallas", "stripe_pallas", "cols_bitplane")), res
+
+
+def test_mesh_overhead_smoke():
+    got = _run_tool(
+        "gpu_rscode_tpu.tools.mesh_overhead",
+        "--mb", "1", "2", "--trials", "1", timeout=360,
+    )
+    modes = {d["mode"] for d in got if "devices" in d}
+    assert modes == {"single", "cols", "stripe"}
+    ratios = [d for d in got if "overhead_vs_single" in d]
+    assert {d["mode"] for d in ratios} == {"cols", "stripe"}
 
 
 def test_capture_scripts_are_valid_bash():
