@@ -25,7 +25,9 @@
 #include <fcntl.h>
 #include <unistd.h>
 
+#include <atomic>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
 #include <thread>
 #include <vector>
@@ -50,6 +52,49 @@ uint8_t slow_mul(uint32_t a, uint32_t b) {
     if (a & 0x100) a ^= kPoly;
   }
   return static_cast<uint8_t>(r);
+}
+
+// Row-parallel IO fan-out (the 2026-07-30 tmpfs phase split pinned these
+// single-core staging copies — not compute, not disk — as the end-to-end
+// stream bound; stripe rows are independent fds/offsets, so they thread
+// the same way the GEMM's column ranges do).  Threading pays only when
+// the per-call volume dwarfs thread spawn (~50 us each); below 1 MiB the
+// serial loop wins.  RS_NATIVE_IO_THREADS caps the pool (0/1 = serial).
+int io_threads(int rows, long long total_bytes) {
+  if (rows < 2 || total_bytes < (1 << 20)) return 1;
+  int cap = 8;  // page-cache/tmpfs memcpy saturates well before all cores
+  if (const char* env = std::getenv("RS_NATIVE_IO_THREADS")) {
+    cap = std::atoi(env);
+    if (cap < 1) cap = 1;
+  }
+  unsigned hw = std::thread::hardware_concurrency();
+  int nt = hw ? static_cast<int>(hw) : 1;
+  if (nt > cap) nt = cap;
+  return nt < rows ? nt : rows;
+}
+
+// Run fn(row) over rows 0..k-1 on nt threads (round-robin assignment —
+// rows are similar-sized, so striding balances without a work queue).
+// fn returns false on failure; any failure makes the whole call fail,
+// and workers finish their current row then stop.
+template <typename Fn>
+bool run_rows(int k, int nt, Fn fn) {
+  if (nt <= 1) {
+    for (int i = 0; i < k; ++i)
+      if (!fn(i)) return false;
+    return true;
+  }
+  std::atomic<bool> ok{true};
+  std::vector<std::thread> workers;
+  workers.reserve(nt);
+  for (int w = 0; w < nt; ++w) {
+    workers.emplace_back([&, w]() {
+      for (int i = w; i < k && ok.load(std::memory_order_relaxed); i += nt)
+        if (!fn(i)) ok.store(false, std::memory_order_relaxed);
+    });
+  }
+  for (auto& th : workers) th.join();
+  return ok.load();
 }
 
 void gemm_range_scalar(const uint8_t* A, const uint8_t* B, uint8_t* C, int p,
@@ -287,8 +332,10 @@ long long rs_stripe_read(const char* path, uint8_t* dst, long long chunk,
                          long long total_size) {
   const int fd = open(path, O_RDONLY);
   if (fd < 0) return -1;
-  long long got_total = 0;
-  for (int i = 0; i < k; ++i) {
+  // pread carries its own offset, so concurrent row reads share one fd.
+  std::atomic<long long> got_total{0};
+  const bool ok = run_rows(k, io_threads(k, static_cast<long long>(k) * cols),
+                           [&](int i) {
     uint8_t* row = dst + static_cast<long long>(i) * cols;
     const long long lo = static_cast<long long>(i) * chunk + off;
     long long hi = lo + cols;
@@ -301,17 +348,15 @@ long long rs_stripe_read(const char* path, uint8_t* dst, long long chunk,
     while (done < want) {
       const ssize_t n = pread(fd, row + done, static_cast<size_t>(want - done),
                               lo + done);
-      if (n <= 0) {  // error or unexpected EOF: fail loudly, never zero-fill
-        close(fd);   // silently (zeroed data would encode corrupt parity)
-        return -1;
-      }
-      done += n;
-    }
-    got_total += done;
+      if (n <= 0) return false;  // error or unexpected EOF: fail loudly,
+      done += n;                 // never zero-fill silently (zeroed data
+    }                            // would encode corrupt parity)
+    got_total.fetch_add(done, std::memory_order_relaxed);
     if (done < cols) std::memset(row + done, 0, static_cast<size_t>(cols - done));
-  }
+    return true;
+  });
   close(fd);
-  return got_total;
+  return ok ? got_total.load() : -1;
 }
 
 // Gather one cols-byte segment at offset off from each of k open chunk
@@ -321,34 +366,38 @@ long long rs_stripe_read(const char* path, uint8_t* dst, long long chunk,
 // output).  Returns 0, or -1 on any read failure.
 int rs_gather_rows(const int* fds, uint8_t* dst, int k, long long off,
                    long long cols) {
-  for (int i = 0; i < k; ++i) {
+  const bool ok = run_rows(k, io_threads(k, static_cast<long long>(k) * cols),
+                           [&](int i) {
     uint8_t* row = dst + static_cast<long long>(i) * cols;
     long long done = 0;
     while (done < cols) {
       const ssize_t n = pread(fds[i], row + done,
                               static_cast<size_t>(cols - done), off + done);
-      if (n <= 0) return -1;
+      if (n <= 0) return false;
       done += n;
     }
-  }
-  return 0;
+    return true;
+  });
+  return ok ? 0 : -1;
 }
 
 // Scatter p parity row segments to p files at offset off (pwrite).
 // fds: open file descriptors.  Returns 0, or -1 on short write.
 int rs_scatter_write(const int* fds, const uint8_t* src, int p,
                      long long cols, long long off) {
-  for (int i = 0; i < p; ++i) {
+  const bool ok = run_rows(p, io_threads(p, static_cast<long long>(p) * cols),
+                           [&](int i) {
     const uint8_t* row = src + static_cast<long long>(i) * cols;
     long long done = 0;
     while (done < cols) {
       const ssize_t n = pwrite(fds[i], row + done,
                                static_cast<size_t>(cols - done), off + done);
-      if (n <= 0) return -1;
+      if (n <= 0) return false;
       done += n;
     }
-  }
-  return 0;
+    return true;
+  });
+  return ok ? 0 : -1;
 }
 
 }  // extern "C"

@@ -1,0 +1,110 @@
+"""Host staging-IO throughput: serial vs row-threaded native calls.
+
+The r4 tmpfs phase split (stream_tmpfs_cpu_20260730T*) attributed the
+end-to-end stream bound to "single-core IO copies"; round 5 threaded the
+row-parallel native staging (rs_stripe_read / rs_gather_rows /
+rs_scatter_write fan rows across std::threads, rs_native.cpp run_rows) to
+test that attribution.  This tool measures each staging call serial
+(RS_NATIVE_IO_THREADS=1) vs threaded on a tmpfs file, so the verdict —
+does threading lift the copy bound on this host, or is the bound memory
+bandwidth — is a committed artifact rather than an assumption.
+
+Usage: python -m gpu_rscode_tpu.tools.io_bench [--mb 1024] [--trials 3]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=int, default=1024, help="file size MB")
+    ap.add_argument("--k", type=int, default=10)
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--dir", default="/dev/shm", help="work dir (tmpfs)")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    from .. import native
+
+    try:
+        native.get_lib()
+    except native.NativeUnavailable as e:
+        print(f"# native library unavailable ({e}); nothing to measure",
+              file=sys.stderr)
+        return 1
+
+    k = args.k
+    total = args.mb * 1024 * 1024
+    chunk = (total + k - 1) // k
+    cols = min(13 * 1024 * 1024, chunk)  # --mb bounds the working set too
+    rng = np.random.default_rng(0)
+
+    with tempfile.TemporaryDirectory(dir=args.dir) as d:
+        path = os.path.join(d, "probe.bin")
+        with open(path, "wb") as fp:
+            # Write REAL bytes for the whole file — a truncate-extended
+            # tail would be a tmpfs hole served without page copies,
+            # inflating the read numbers this tool exists to pin down.
+            left = total
+            while left > 0:
+                n = min(left, 64 << 20)
+                fp.write(rng.integers(0, 256, n, np.uint8).tobytes())
+                left -= n
+        rows = [os.path.join(d, f"row{i}") for i in range(k)]
+        seg = rng.integers(0, 256, size=(k, cols), dtype=np.uint8)
+
+        def t_stripe():
+            for off in range(0, chunk, cols):
+                c = min(cols, chunk - off)
+                native.stripe_read(path, chunk, k, off, c, total)
+
+        def t_scatter():
+            fps = [open(r, "r+b" if os.path.exists(r) else "w+b")
+                   for r in rows]
+            try:
+                native.scatter_write(fps, seg, 0)
+            finally:
+                for fp in fps:
+                    fp.close()
+
+        def t_gather():
+            fps = [open(r, "rb") for r in rows]
+            try:
+                native.gather_rows(fps, 0, cols)
+            finally:
+                for fp in fps:
+                    fp.close()
+
+        t_scatter()  # materialize the row files before gather reads them
+        cases = (
+            ("stripe_read", t_stripe, total),
+            ("scatter_write", t_scatter, seg.nbytes),
+            ("gather_rows", t_gather, seg.nbytes),
+        )
+        for name, fn, nbytes in cases:
+            row = {"metric": "staging_io_gbps", "call": name,
+                   "mb": round(nbytes / 1e6)}
+            for env, label in (("1", "serial"), ("8", "threads8")):
+                os.environ["RS_NATIVE_IO_THREADS"] = env
+                best = float("inf")
+                fn()  # warm page cache / allocations
+                for _ in range(args.trials):
+                    t0 = time.perf_counter()
+                    fn()
+                    best = min(best, time.perf_counter() - t0)
+                row[label] = round(nbytes / best / 1e9, 2)
+            row["speedup"] = round(row["threads8"] / row["serial"], 2)
+            print(json.dumps(row), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

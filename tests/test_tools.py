@@ -63,6 +63,22 @@ def test_inverse_bench_smoke():
     assert row["nopivot_dispatch_s"] > 0 and row["nopivot_ok"] > 0
 
 
+def test_io_bench_smoke():
+    import pytest
+
+    from gpu_rscode_tpu import native
+
+    if not native.available():
+        pytest.skip("native library unavailable (no C++ toolchain)")
+    got = _run_tool(
+        "gpu_rscode_tpu.tools.io_bench", "--mb", "64", "--trials", "1",
+        "--dir", "/tmp",
+    )
+    calls = {d["call"] for d in got}
+    assert calls == {"stripe_read", "scatter_write", "gather_rows"}
+    assert all(d["serial"] > 0 and d["threads8"] > 0 for d in got)
+
+
 def test_mesh_bench_smoke():
     got = _run_tool(
         "gpu_rscode_tpu.tools.mesh_bench", "--mb", "2", "--trials", "1",
