@@ -2,10 +2,13 @@
 
 Reference surface (main.c:32-164): encode ``-k <k> -n <n> -e <file>``;
 decode ``-d -i <file> -c <conf> [-o <out>]``; tuning ``-p`` (device grid
-cap -> here: GEMM column-tile hint) and ``-s`` (stream count -> here:
-pipeline depth, number of segments in flight); ``-h`` help; upper- and
-lower-case flags both accepted.  ``-i/-c/-o`` are rejected unless a decode
-was selected first, matching the reference's ordering rule.
+cap -> here: per-dispatch SEGMENT sizing — the loose analog; the kernel's
+actual column tile is set from committed sweeps and overridable via env
+``RS_PALLAS_TILE``, the true gridDim.x-cap counterpart) and ``-s``
+(stream count -> here: pipeline depth, number of segments in flight);
+``-h`` help; upper- and lower-case flags both accepted.  ``-i/-c/-o`` are
+rejected unless a decode was selected first, matching the reference's
+ordering rule.
 
 Extensions (flagged long options, no reference equivalent):
 ``--generator {vandermonde,cauchy}``,
@@ -33,7 +36,9 @@ For encoding, the -k, -n, and -e options are all necessary.
 For decoding, the -d, -i, and -c options are all necessary.
 If -o is not set, the original file name is used as the output file name.
 Performance-tuning options:
-[-p|-P]: column-tile size hint for the GF-GEMM kernel
+[-p|-P]: per-dispatch segment-size hint (p * 128 KiB per segment); the
+         kernel's internal column tile comes from committed sweeps and is
+         overridable via env RS_PALLAS_TILE
 [-s|-S]: pipeline depth (segments in flight, default 2)
 Extensions: [--generator vandermonde|cauchy]
             [--strategy auto|bitplane|table|pallas|cpu]  (default auto:

@@ -437,14 +437,15 @@ def _default_expand(w: int, acc_dtype) -> str:
     return "shift_raw"
 
 
-def _fallback_expand(reason: str, to: str) -> str:
-    """Env-selected modes keep the warn-and-fall-back guarantee: an env
-    value that is unknown or inapplicable must neither crash production
-    nor silently record a capture under a non-default formulation — the
-    fallback target is the production default that applies."""
+def _env_fallback(reason: str, to, label: str | None = None):
+    """Warn-and-fall-back hygiene shared by every RS_PALLAS_* env knob
+    (EXPAND / REFOLD / TILE): an env value that is unknown or inapplicable
+    must neither crash production nor silently record a capture under a
+    non-default configuration — the fallback target is the production
+    default that applies, named in one uniformly-worded warning."""
     import warnings
 
-    warnings.warn(f"{reason}; using {to!r}", stacklevel=3)
+    warnings.warn(f"{reason}; using {label or repr(to)}", stacklevel=3)
     return to
 
 
@@ -527,7 +528,7 @@ def gf_matmul_pallas(
                 expand in _ANY_W or w == 8 or (w == 16 and expand == "sign")
             )
             if not applies:
-                expand = _fallback_expand(
+                expand = _env_fallback(
                     f"RS_PALLAS_EXPAND={expand!r} is unknown or does not "
                     f"apply at w={w}",
                     _default_expand(w, acc_dtype),
@@ -560,7 +561,7 @@ def gf_matmul_pallas(
         # per-column bit-plane accumulators from_bitplanes expects.
         why = "pack2 cannot emit pre-parity accumulators"
         if from_env:
-            expand = _fallback_expand(
+            expand = _env_fallback(
                 f"RS_PALLAS_EXPAND=pack2 does not apply here ({why})",
                 _default_expand(w, acc_dtype),
             )
@@ -577,6 +578,25 @@ def gf_matmul_pallas(
     # width-specific sweep lands.  shift_raw at w=16 requires int8 anyway.
     deep = w == 8 and A.shape[1] * w >= DEEP_CONTRACTION
     if tile is None:
+        # RS_PALLAS_TILE: whole-pipeline tile experiments without touching
+        # call sites (the CLI's -p cannot reach the kernel tile — it sizes
+        # segments; this knob is the actual gridDim.x-cap analog of the
+        # reference's -p, encode.cu:348-355).  Same warn-and-fall-back
+        # hygiene as RS_PALLAS_EXPAND/REFOLD; an explicit argument wins.
+        import os
+
+        env = os.environ.get("RS_PALLAS_TILE")
+        if env:
+            try:
+                tile = int(env)
+                if tile <= 0:
+                    raise ValueError(env)
+            except ValueError:
+                tile = _env_fallback(
+                    f"RS_PALLAS_TILE={env!r} is not a positive integer",
+                    None, label="the measured default",
+                )
+    if tile is None:
         tile = DEFAULT_TILE if interpret else (DEEP_TILE if deep else TPU_TILE)
     acc_explicit = acc_dtype is not None
     if acc_dtype is None:
@@ -591,7 +611,7 @@ def gf_matmul_pallas(
         # and exact in bf16.)  Env-selected modes keep the warn-and-fall-
         # back guarantee instead of crashing production.
         if from_env:
-            expand = _fallback_expand(
+            expand = _env_fallback(
                 "RS_PALLAS_EXPAND=shift_raw needs acc_dtype=int8 at w=16",
                 _default_expand(w, acc_dtype),
             )
@@ -645,17 +665,9 @@ def gf_matmul_pallas(
         default_refold = "dot" if w == 8 else "sum"
         refold = os.environ.get("RS_PALLAS_REFOLD") or default_refold
         if refold not in ("sum", "dot"):
-            import warnings
-
-            # Fall back to the production default, matching the expand-side
-            # policy: an env typo must not silently record a capture under
-            # a non-default formulation.
-            warnings.warn(
-                f"RS_PALLAS_REFOLD={refold!r} is unknown; "
-                f"using {default_refold!r}",
-                stacklevel=2,
+            refold = _env_fallback(
+                f"RS_PALLAS_REFOLD={refold!r} is unknown", default_refold
             )
-            refold = default_refold
     if refold not in ("sum", "dot"):
         raise ValueError(f"unknown refold {refold!r}")
     return _pallas_matmul(

@@ -7,7 +7,10 @@ rs_scatter_write fan rows across std::threads, rs_native.cpp run_rows) to
 test that attribution.  This tool measures each staging call serial
 (RS_NATIVE_IO_THREADS=1) vs threaded on a tmpfs file, so the verdict —
 does threading lift the copy bound on this host, or is the bound memory
-bandwidth — is a committed artifact rather than an assumption.
+bandwidth — is a committed artifact rather than an assumption.  Each row
+records ``host_cores``: the pool is min(cap, host_cores, rows), so on a
+1-core host (this build VM) the "threads8" column clamps to serial and
+parity between the columns is expected, not a threading verdict.
 
 Usage: python -m gpu_rscode_tpu.tools.io_bench [--mb 1024] [--trials 3]
 """
@@ -90,8 +93,12 @@ def main() -> int:
             ("gather_rows", t_gather, seg.nbytes),
         )
         for name, fn, nbytes in cases:
+            # host_cores makes the capture self-describing: the effective
+            # pool is min(8, host_cores, rows), so a "threads8" column on
+            # a 4-core host really measured 4 threads.
             row = {"metric": "staging_io_gbps", "call": name,
-                   "mb": round(nbytes / 1e6)}
+                   "mb": round(nbytes / 1e6), "k": k,
+                   "host_cores": os.cpu_count()}
             for env, label in (("1", "serial"), ("8", "threads8")):
                 os.environ["RS_NATIVE_IO_THREADS"] = env
                 best = float("inf")

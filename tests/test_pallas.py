@@ -264,6 +264,35 @@ def test_refold_env_override(monkeypatch):
     assert seen[-1]["refold"] == "dot"
 
 
+def test_tile_env_override(monkeypatch):
+    """RS_PALLAS_TILE sets the kernel column tile (the true analog of the
+    reference's -p gridDim.x cap — the CLI's -p sizes segments instead);
+    non-positive-int values warn and fall back to the measured default,
+    and an explicit tile argument always wins over the env."""
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    seen = []
+    _spy_matmul(monkeypatch, seen)
+    gf = get_field(8)
+    rng = np.random.default_rng(33)
+    A = rng.integers(0, 256, size=(2, 4), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(4, 2048), dtype=np.uint8)
+    want = gf.matmul(A, B)
+    monkeypatch.setenv("RS_PALLAS_TILE", "256")
+    np.testing.assert_array_equal(np.asarray(gf_matmul_pallas(A, B)), want)
+    assert seen[-1]["tile"] == 256
+    np.testing.assert_array_equal(
+        np.asarray(gf_matmul_pallas(A, B, tile=512)), want
+    )
+    assert seen[-1]["tile"] == 512  # explicit argument beats the env
+    monkeypatch.setenv("RS_PALLAS_TILE", "zero")
+    with pytest.warns(UserWarning, match="RS_PALLAS_TILE"):
+        np.testing.assert_array_equal(
+            np.asarray(gf_matmul_pallas(A, B)), want
+        )
+    assert seen[-1]["tile"] == pg.DEFAULT_TILE  # interpret-mode default
+
+
 def test_production_defaults(monkeypatch):
     """The measured production defaults (expand_r4b_*/expand_r4c_*
     captures): expand='shift_raw' + refold='dot' at w=8; w=16 keeps

@@ -51,6 +51,39 @@ def test_strategies_agree(k, p, m, seed):
     np.testing.assert_array_equal(native.gemm(A, B), want)
 
 
+@settings(max_examples=25, deadline=None)
+@given(
+    data=st.data(),
+    k=st.integers(1, 12),
+    p=st.integers(1, 6),
+)
+def test_nopivot_inverse_sound(data, k, p):
+    """The scan-free batched inverse is SOUND for any survivor subset in
+    the production arrangement: it either returns the exact inverse
+    (ok=True, equal to the host inverter) or flags ok=False — never a
+    wrong unflagged inverse.  And for the Cauchy generator it must ALWAYS
+    succeed: with identity rows on their own positions, every elimination
+    leading minor is a square Cauchy submatrix determinant — nonzero."""
+    from gpu_rscode_tpu.models.vandermonde import cauchy_matrix
+    from gpu_rscode_tpu.ops.inverse import (
+        invert_matrix,
+        invert_matrix_jax_nopivot,
+        mds_nopivot_order,
+    )
+
+    T = np.concatenate(
+        [np.eye(k, dtype=np.uint8), cauchy_matrix(p, k)], axis=0
+    )
+    surv = data.draw(st.permutations(range(k + p)).map(lambda x: list(x)[:k]))
+    rows = mds_nopivot_order(sorted(surv), k)
+    sub = T[rows]
+    got, ok = invert_matrix_jax_nopivot(sub)
+    assert bool(ok), f"no-pivot failed on a Cauchy subset {rows}"
+    np.testing.assert_array_equal(
+        np.asarray(got, dtype=np.uint8), invert_matrix(sub)
+    )
+
+
 @settings(max_examples=15, deadline=None)
 @given(
     k=st.integers(1, 8),
