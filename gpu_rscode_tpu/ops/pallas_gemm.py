@@ -52,7 +52,7 @@ Headline (k=10, p=4): 102.5 GB/s (was 64.7 under shift+sum); k=64: 132.0;
 k=128: 133.6; decode shape p=k=10: 80.5.  w=16 measured 101.9 under
 shift_raw (was 90.3 under shift), but its refold there is "sum": the one
 w=16+dot attempt died at the capture timeout with the tunnel wedging
-right after (hang vs tunnel unresolved — tools/tpu_probe_r4d.sh
+right after (hang vs tunnel unresolved — tools/tpu_probe_r5.sh
 re-probes), so w!=8 keeps the sum refold.  ``"sign"`` and ``"nibble"`` do NOT
 lower on the current Mosaic toolchain (sign: ``arith.subi`` on int8
 vectors fails to legalize; nibble: 8-bit iota unsupported; reworked
@@ -491,7 +491,7 @@ def gf_matmul_pallas(
     hardware — pack2 correctly only under Precision.HIGHEST, whose cost
     sinks it to 2.4 GB/s (rejected; see module docstring).  "nibble32"
     (the nibble one-hot in int32 lanes, the lowerable lane width) awaits
-    its hardware verdict (tools/tpu_probe_r4e.sh); the remaining modes
+    its hardware verdict (tools/tpu_probe_r5.sh); the remaining modes
     fail Mosaic legalization (bench_captures/expand_probe_*) and serve
     interpret mode.
     ``refold``: how the kernel folds accumulator parities back into GF
@@ -661,7 +661,7 @@ def gf_matmul_pallas(
         # hardware attempt (r4c w16_raw_dot) died at the 900 s timeout
         # with the tunnel wedging right after — hang-vs-tunnel unresolved,
         # and an unvalidated default that can hang must not ship
-        # (tools/tpu_probe_r4d.sh re-probes it).
+        # (tools/tpu_probe_r5.sh re-probes it).
         default_refold = "dot" if w == 8 else "sum"
         refold = os.environ.get("RS_PALLAS_REFOLD") or default_refold
         if refold not in ("sum", "dot"):
