@@ -108,6 +108,26 @@ def _committed_tpu_headline(caps: list | None = None) -> dict | None:
     return None
 
 
+def _attach_committed_evidence(detail: dict) -> dict:
+    """Attach the committed hardware evidence (capture path list + newest
+    VALID headline inlined) to a CPU/error artifact's detail dict.  ONE
+    copy shared by all three emission paths — main's fallback, the
+    watchdog's held-CPU line, and the watchdog's error line — so the
+    artifacts cannot drift.  Exception-safe by contract: two of those
+    callers run on the watchdog thread, where a raised exception would
+    kill the thread silently and lose the output line entirely."""
+    try:
+        caps = _committed_tpu_captures()
+        if caps:
+            detail["committed_tpu_captures"] = caps
+        headline = _committed_tpu_headline(caps)
+        if headline:
+            detail["latest_committed_tpu"] = headline
+    except Exception:
+        pass  # evidence is best-effort; the line itself must still emit
+    return detail
+
+
 _PARTIAL = None  # (backend, best, detail) once a VERIFIED number exists
 
 
@@ -149,10 +169,16 @@ def _arm_wedge_watchdog(delay: float | None = None) -> None:
         if held is not None:
             backend, best, detail = held
             try:
+                extra = {}
+                if backend != "tpu":
+                    # The held CPU line gets the same hardware evidence
+                    # the normal fallback path adds at the end of main()
+                    # — a wedge must not strip it.
+                    _attach_committed_evidence(extra)
                 emitted = _emit(
                     backend, best[1],
                     {
-                        "strategy": best[0], **detail,
+                        "strategy": best[0], **detail, **extra,
                         "watchdog": "fired before the run fully completed; "
                                     "value is the verified encode "
                                     "measurement",
@@ -176,11 +202,10 @@ def _arm_wedge_watchdog(delay: float | None = None) -> None:
                 os._exit(0)
         elif _emit(
             "error", 0.0,
-            {
+            _attach_committed_evidence({
                 "error": f"watchdog: no result after {budget:.0f}s "
                          "(device wedged mid-run?)",
-                "committed_tpu_captures": _committed_tpu_captures(),
-            },
+            }),
         ):
             _mark("watchdog fired; device wedged mid-run")
             os._exit(1)
@@ -623,14 +648,9 @@ def main() -> None:
         return  # the forwarded TPU line is the bench's single output line
     if backend != "tpu":
         # A CPU line means the tunnel was down for this run, not that no TPU
-        # number exists — point readers of the artifact at the committed
-        # same-config hardware captures.
-        caps = _committed_tpu_captures()
-        if caps:
-            detail["committed_tpu_captures"] = caps
-        headline = _committed_tpu_headline(caps)
-        if headline:
-            detail["latest_committed_tpu"] = headline
+        # number exists — attach the committed same-config hardware
+        # evidence (paths + inlined headline).
+        _attach_committed_evidence(detail)
     _emit(backend, best[1], {"strategy": best[0], **detail})
 
 

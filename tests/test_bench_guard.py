@@ -136,6 +136,34 @@ def test_watchdog_emits_held_result_instead_of_error():
     assert "watchdog" in line["detail"]
 
 
+def test_watchdog_held_cpu_line_carries_hardware_headline():
+    # A wedge that latches a held CPU partial must still inline the newest
+    # valid committed hardware headline — same evidence the normal
+    # fallback path adds at the end of main().
+    code = (
+        "import importlib.util, time\n"
+        f"spec = importlib.util.spec_from_file_location('b', {os.path.join(REPO, 'bench.py')!r})\n"
+        "m = importlib.util.module_from_spec(spec); spec.loader.exec_module(m)\n"
+        "m._PARTIAL = ('cpu', ('native', 7.0), {'native': 7.0})\n"
+        "m._arm_wedge_watchdog()\n"
+        "time.sleep(10)\n"
+    )
+    env = dict(os.environ, RS_BENCH_WATCHDOG_S="1", PYTHONPATH="")
+    run = subprocess.run(
+        [sys.executable, "-c", code], env=env, capture_output=True,
+        text=True, timeout=30, cwd=REPO,
+    )
+    assert run.returncode == 0
+    line = json.loads(run.stdout.strip().splitlines()[0])
+    assert line["metric"].endswith("_cpu") and line["value"] == 7.0
+    h = line["detail"].get("latest_committed_tpu")
+    # The repo carries committed bench_tpu_* captures; the newest valid one
+    # must be inlined with a positive value, alongside the path list (the
+    # same evidence pair every CPU/error emission path attaches).
+    assert h and h["value"] > 0 and h["metric"].endswith("_tpu")
+    assert line["detail"].get("committed_tpu_captures")
+
+
 def test_watchdog_armed_even_in_hardware_only_mode():
     # RS_BENCH_NO_FALLBACK means "no CPU fallback", not "no wedge guard" —
     # a hardware-only run is the MOST exposed to a tunnel wedge.
