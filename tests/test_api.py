@@ -874,6 +874,31 @@ def test_repair_fleet_batched_inversion(tmp_path):
             ), f"{path} chunk {i}"
 
 
+def test_repair_fleet_mixed_widths(tmp_path):
+    """A fleet mixing GF(2^8) and GF(2^16) archives groups by (k, w) and
+    rebuilds each byte-identically — the wide-symbol field goes through
+    the same batched no-pivot inversion path (tables(16) gathers)."""
+    a = _mkfile(tmp_path, 6000, seed=41)
+    b = _mkfile(tmp_path, 6002, seed=42)  # even size: w=16 symbol-aligned
+    api.encode_file(a, 4, 2, checksums=True)
+    api.encode_file(b, 4, 2, w=16, checksums=True)
+    golden = {
+        p: {i: open(chunk_file_name(p, i), "rb").read() for i in range(6)}
+        for p in (a, b)
+    }
+    os.remove(chunk_file_name(a, 0))
+    os.remove(chunk_file_name(b, 1))
+    os.remove(chunk_file_name(b, 3))
+
+    results = api.repair_fleet([a, b])
+    assert results == {a: [0], b: [1, 3]}
+    for p in (a, b):
+        for i in range(6):
+            assert (
+                open(chunk_file_name(p, i), "rb").read() == golden[p][i]
+            ), f"{p} chunk {i}"
+
+
 def test_repair_fleet_deep_k_routes_to_host_on_tpu(tmp_path, monkeypatch):
     """Measured routing (bench_captures/inverse_tpu_20260731T*): on TPU
     backends the batched device inverter loses above k=32, so deep-k

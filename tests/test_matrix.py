@@ -147,6 +147,14 @@ def test_mds_nopivot_order_places_natives_on_diagonal():
     # All-natives and all-parity edge cases.
     assert mds_nopivot_order([0, 1, 2], 3) == [0, 1, 2]
     assert mds_nopivot_order([3, 4, 5], 3) == [3, 4, 5]
+    # Always a permutation of the input subset (the inverse must pair with
+    # chunks stacked in exactly this order), natives at own positions.
+    rng = np.random.default_rng(5)
+    for k in (1, 3, 8, 17):
+        rows = sorted(rng.choice(2 * k, size=k, replace=False).tolist())
+        out = mds_nopivot_order(rows, k)
+        assert sorted(out) == rows
+        assert all(out[r] == r for r in rows if r < k)
 
 
 def test_invert_jax_nopivot_flags_zero_leading_minor():
