@@ -92,6 +92,10 @@ def _committed_tpu_headline(caps: list | None = None) -> dict | None:
                 d = json.loads(fp.read().strip().splitlines()[-1])
             if not (isinstance(d.get("value"), (int, float)) and d["value"] > 0):
                 continue
+            if not str(d.get("metric", "")).endswith("_tpu"):
+                # A mislabeled non-hardware file under the bench_tpu_
+                # prefix must not become the inlined hardware evidence.
+                continue
             det = d.get("detail") or {}
             return {
                 "file": os.path.basename(path),

@@ -51,6 +51,14 @@ def test_committed_tpu_headline_inlines_values(tmp_path):
         p = tmp_path / name
         p.write_text(json.dumps(payload) + "\n")
         caps.append(str(p))
+    mislabeled = {
+        "metric": "encode_bandwidth_k10_n14_cpu", "value": 6.5,
+        "unit": "GB/s", "vs_baseline": 4.8,
+        "detail": {"strategy": "native"},
+    }
+    p = tmp_path / "bench_tpu_2b.json"  # promoted by mistake: CPU metric
+    p.write_text(json.dumps(mislabeled) + "\n")
+    caps.append(str(p))
     broken = tmp_path / "bench_tpu_3.json"
     broken.write_text("not json\n")
     caps.append(str(broken))

@@ -43,8 +43,14 @@ capture_bench() {
     > "bench_captures/bench_${ts}.json" 2> "bench_captures/bench_${ts}.log"
   local rc=$?
   echo "# bench rc=${rc}" >&2
+  # Promotion keys on the TOP-LEVEL metric name, anchored at line start
+  # (bench.py's _emit always writes "metric" first): a CPU-fallback line
+  # embeds a "latest_committed_tpu" evidence dict whose own inner
+  # "metric" ends in _tpu, so any unanchored grep would mislabel a CPU
+  # artifact as hardware.
   if [ -s "bench_captures/bench_${ts}.json" ] \
-      && grep -q '_tpu"' "bench_captures/bench_${ts}.json"; then
+      && grep -Eq '^\{"metric": "[a-z0-9_]*_tpu"' \
+           "bench_captures/bench_${ts}.json"; then
     mv "bench_captures/bench_${ts}.json" \
        "bench_captures/bench_tpu_${ts}.json"
     mv "bench_captures/bench_${ts}.log" \
@@ -55,9 +61,14 @@ capture_bench() {
   else
     # Empty captures are removed, not committed (same rule as capture());
     # the .log alone still carries the audit value of a failed attempt.
-    [ -s "bench_captures/bench_${ts}.json" ] \
-      && git add "bench_captures/bench_${ts}.json" 2>/dev/null \
-      || rm -f "bench_captures/bench_${ts}.json"
+    # The rm is gated ONLY on emptiness — a failed git add (e.g. a
+    # concurrent watcher holding index.lock) must not destroy a
+    # non-empty artifact.
+    if [ -s "bench_captures/bench_${ts}.json" ]; then
+      git add "bench_captures/bench_${ts}.json" 2>/dev/null
+    else
+      rm -f "bench_captures/bench_${ts}.json"
+    fi
     git add "bench_captures/bench_${ts}.log" 2>/dev/null
     git commit -q -m "bench capture attempt (rc=${rc}, no TPU line)" \
       2>/dev/null
