@@ -72,27 +72,31 @@ class ChunkIntegrityError(ValueError):
 # per in-flight segment (k rows x seg_cols bytes).
 DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
 
-# Fleet repair routes batched survivor inversions to the device only up to
-# this k on TPU backends: the v5e capture shows the vmapped Gauss-Jordan
-# winning at k <= 32 (sequential pivot scan still amortized by the batch)
-# and losing at k = 128 (bench_captures/inverse_tpu_20260731T032339Z.jsonl;
-# crossover between 32 and 128 unmeasured, so the threshold sits at the
-# last measured win).  Round 5 switches the device dispatch to the
-# scan-free no-pivot elimination (ops.inverse, pivot=False), which removes
-# the per-step argmax/permutation that capture blamed for the k=128 loss —
-# the threshold stays until the r5 inverse_nopivot capture re-measures it
-# (tools/tpu_probe_r5.sh).
-_DEVICE_INVERT_MAX_K_TPU = 32
-
-# The same v5e capture shows the device dispatch losing at SMALL batches
-# for every k (0.2x at k=10/batch=64, 0.77x at k=10/batch=256; it wins
-# near batch ~1024) — and a typical scrub finds few damaged archives per
-# (k, w) group, so small groups take the host path.  Same policy as the k
-# threshold: sit at the LAST MEASURED WIN (batch 1024) until the r5
-# capture measures the 256..1024 crossover (tools/tpu_probe_r5.sh probes
-# batch 16/64/256/1024).  CPU backends keep the ungated device dispatch
-# (14-136x at every measured point, inverse_cpu_20260730T174508Z.jsonl).
-_DEVICE_INVERT_MIN_BATCH_TPU = 1024
+# Fleet repair routes batched survivor inversions to the device on TPU
+# backends per the measured k x batch grid
+# (bench_captures/inverse_nopivot_tpu_20260801T001751Z.jsonl, real v5e):
+# the device wins at (k=10, b=1024: 3.46x), (k=32, b>=256: 2.1-5.6x) and
+# (k=64, b>=64: 1.10-1.25x — a thin but consistent margin across three
+# batch sizes); it loses at every k=128 cell (0.54-0.90x) and at small
+# batches for every k (the ~0.13-0.15 s flat dispatch floor is the tunnel
+# round trip — a colocated host would cross over earlier).  That capture
+# also REFUTES the r4 hypothesis that the per-step pivot scan caused the
+# k=128 loss: the scan-free no-pivot elimination times are identical to
+# the pivoting ones on TPU (the lax.scan over k elimination steps itself
+# is the cost), so depth stays host-routed.  CPU backends keep the
+# ungated device dispatch (14-136x at every measured point,
+# inverse_cpu_20260730T174508Z.jsonl).
+def _device_invert_min_batch_tpu(k: int) -> int | None:
+    """Smallest group size at which the batched device inverter measured
+    faster than the per-archive host loop on TPU, or None if the host
+    path won at every measured batch for this depth."""
+    if k <= 16:
+        return 1024
+    if k <= 48:
+        return 256
+    if k <= 64:
+        return 64
+    return None
 
 
 def _segment_cols(chunk_size: int, native_num: int, segment_bytes: int) -> int:
@@ -1763,18 +1767,12 @@ def repair_fleet(
 
         for (k, w), group in groups.items():
             gf = get_field(w)
+            min_batch = _device_invert_min_batch_tpu(k)
             if tpu_devices_present() and (
-                k > _DEVICE_INVERT_MAX_K_TPU
-                or len(group) < _DEVICE_INVERT_MIN_BATCH_TPU
+                min_batch is None or len(group) < min_batch
             ):
-                # Measured routing (bench_captures/inverse_tpu_20260731T*):
-                # on a real v5e the batched device inverter wins only at
-                # k <= 32 AND large batches (up to 3.0x near batch 1024);
-                # it loses at k = 128 (0.56-0.67x — the sequential pivot
-                # scan) and at small batches for every k (0.2x at
-                # batch=64), so deep configs and small groups take the
-                # host path.  On CPU backends the batched dispatch wins at
-                # every measured point (14-136x, inverse_cpu_20260730T*).
+                # Measured routing — see _device_invert_min_batch_tpu for
+                # the k x batch grid and its capture citation.
                 for f in group:
                     try:
                         chosen_inv[f] = _select_decodable_subset(scans[f])
@@ -1786,9 +1784,11 @@ def repair_fleet(
             # (mds_nopivot_order), pivoting is only ever needed inside the
             # tiny parity Schur complement — rare, flagged by ok=False,
             # and re-solved through the host search below.  Every inverse
-            # is verified before use either way, so dropping the
-            # sequential per-step argmax/permutation (the measured k=128
-            # loss, inverse_tpu_20260731T032339Z.jsonl) is safe.
+            # is verified before use either way.  On TPU the no-pivot
+            # times are indistinguishable from the pivoting ones
+            # (inverse_nopivot_tpu_20260801T*: the elimination scan, not
+            # the pivot search, is the cost), so this stays the dispatch
+            # for its CPU win (1.25x, builder smoke) and simpler kernel.
             ordered = {
                 f: mds_nopivot_order(scans[f].healthy[:k], k) for f in group
             }

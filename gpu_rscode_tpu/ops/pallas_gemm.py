@@ -50,10 +50,17 @@ expansion beat ``shift`` at every probed shape, and moving the parity
 refold onto the MXU beat the VPU shift-sum at every probed w=8 shape.
 Headline (k=10, p=4): 102.5 GB/s (was 64.7 under shift+sum); k=64: 132.0;
 k=128: 133.6; decode shape p=k=10: 80.5.  w=16 measured 101.9 under
-shift_raw (was 90.3 under shift), but its refold there is "sum": the one
-w=16+dot attempt died at the capture timeout with the tunnel wedging
-right after (hang vs tunnel unresolved — tools/tpu_probe_r5.sh
-re-probes), so w!=8 keeps the sum refold.  ``"sign"`` and ``"nibble"`` do NOT
+shift_raw (was 90.3 under shift) with the "sum" refold.  The r4c
+w16+dot timeout was the TUNNEL, not a hang (resolved 2026-08-01: both
+small-shape re-probes returned rc=0, w16_small_*_tpu_20260801T*) —
+but the r5c crossover sweep showed w16+dot is BIMODAL at fixed shape
+(mb=128: 84.8 / 82.3 / 147.6 across three runs; mb=64: 142.3; mb=320:
+147.0; mb=32: 8.2) where sum is stable (101.7-102.6 at every probed
+size, w16_cross_*_tpu_20260801T*).  A default that regresses below
+sum on roughly half its dispatches is not shippable, so w=16 keeps
+"sum"; RS_PALLAS_REFOLD=dot opts into the 147 GB/s fast mode for
+callers who can tolerate the variance.  ``"sign"`` and ``"nibble"``
+do NOT
 lower on the current Mosaic toolchain (sign: ``arith.subi`` on int8
 vectors fails to legalize; nibble: 8-bit iota unsupported; reworked
 int32-iota formulations crash the compile helper) — see
@@ -83,15 +90,18 @@ DEFAULT_TILE = 2048      # interpret / CPU-mesh default
 # (bench_captures/tile_pick_tpu_20260730T050344Z.jsonl: 64.33 @ 16384, 64.63 @
 # 32768 — a tie within tunnel jitter; 47.11 @ 8192, 56.91 @ 65536).
 TPU_TILE = 16384
-# The 2026-07-31 k-sweep capture (k_sweep_tpu_20260731T010808Z.jsonl,
-# k in {4,10,32,64,128} x {int8,bf16} x {8192,16384,32768}) splits the
-# default on contraction depth k*w: int8@16384 below depth 256 (k=10:
-# 64.7 vs bf16's 52), bf16@32768 at or above (k=32: 74.1, k=64: 102.8,
-# k=128: 87.2 — vs 42-67 for int8).  Unlike the reference, which degrades
-# for k >= 32 (design.tex:462-466), throughput GROWS with k: the p*w-row
-# output refold amortizes over more input rows.
-DEEP_CONTRACTION = 256   # k*w at/above which bf16@DEEP_TILE wins
-DEEP_TILE = 32768
+# Depth-split history: the 2026-07-31 PRE-flip k-sweep
+# (k_sweep_tpu_20260731T010808Z.jsonl) had bf16@32768 winning at
+# contraction depth k*w >= 256, so rounds 4-5 shipped a deep-config
+# split.  The POST-flip re-sweep under the production shift_raw+dot
+# kernel (k_sweep_postflip_tpu_20260801T002730Z.jsonl) RETIRED it:
+# int8 wins at every k (k=32: 152.5 vs bf16's 119.0; k=64: 159.8 vs
+# 136.7; k=128: 167.4 vs 140.2), tile 16384 is within ~5 % of the best
+# tile at every depth, and int8@32768 at depth 1024 fails to compile
+# (remote helper HTTP 500) — so int8@TPU_TILE is the one hardware
+# default at w=8.  Unlike the reference, which degrades for k >= 32
+# (design.tex:462-466), throughput GROWS with k: the p*w-row output
+# refold amortizes over more input rows.
 
 
 def _expand_shift(b, w, k, tile):
@@ -470,12 +480,12 @@ def gf_matmul_pallas(
 
     ``acc_dtype``: matmul input dtype — ``int8`` (int32 accumulation, exact
     for contraction depth < 2^31; 2x MXU rate on v5e) or ``bfloat16`` (f32
-    accumulation, exact for depth < 2^24).  Both bit-verified; TPU defaults
-    split on contraction depth k*w at w=8 — int8 @ tile 16384 below
-    DEEP_CONTRACTION (=256), bf16 @ tile 32768 at/above — per the committed
-    v5e captures (tile_pick_tpu_20260730T050344Z.jsonl,
-    k_sweep_tpu_20260731T010808Z.jsonl); other widths keep the shallow
-    defaults until a width-specific sweep is committed.
+    accumulation, exact for depth < 2^24).  Both bit-verified; the TPU
+    default is int8 @ tile 16384 at EVERY depth — the post-flip k-sweep
+    (k_sweep_postflip_tpu_20260801T002730Z.jsonl) retired the old
+    bf16-at-depth>=256 split: under shift_raw+dot, int8 wins at every k
+    (152.5-167.4 GB/s at k=32-128 vs bf16's 119-140) and int8@32768
+    fails to compile at depth 1024.
     ``expand``: data-expansion formulation — "shift_raw" (default; any
     width, but w=16 needs acc_dtype=int8 — unmasked planes exceed bf16's
     exact-integer range, so a w=16 call with an explicit non-int8
@@ -490,10 +500,12 @@ def gf_matmul_pallas(
     current TPU toolchain only "shift"/"shift_raw"/"pack2" lower to
     hardware — pack2 correctly only under Precision.HIGHEST, whose cost
     sinks it to 2.4 GB/s (rejected; see module docstring).  "nibble32"
-    (the nibble one-hot in int32 lanes, the lowerable lane width) awaits
-    its hardware verdict (tools/tpu_probe_r5.sh); the remaining modes
-    fail Mosaic legalization (bench_captures/expand_probe_*) and serve
-    interpret mode.
+    (the nibble one-hot in int32 lanes, the lowerable lane width) is
+    hardware-REFUSED too: it crashes the remote tpu_compile_helper
+    (HTTP 500, nibble32_k10_tpu_20260801T002533Z.jsonl), the same wall
+    as every r4 narrow-lane candidate; it and the remaining modes fail
+    on hardware (bench_captures/expand_probe_*) and serve interpret
+    mode only.
     ``refold``: how the kernel folds accumulator parities back into GF
     elements — "dot" (MXU: one tiny bf16 matmul against the (p, p*w)
     bit-weight operator; exact in f32 for any supported w) or "sum"
@@ -573,10 +585,6 @@ def gf_matmul_pallas(
         from ..utils.backend import tpu_devices_present
 
         interpret = not tpu_devices_present()
-    # The deep-contraction rule is only measured at w=8 (the k-sweep capture
-    # varies k with w=8); other widths keep the shallow defaults until a
-    # width-specific sweep lands.  shift_raw at w=16 requires int8 anyway.
-    deep = w == 8 and A.shape[1] * w >= DEEP_CONTRACTION
     if tile is None:
         # RS_PALLAS_TILE: whole-pipeline tile experiments without touching
         # call sites (the CLI's -p cannot reach the kernel tile — it sizes
@@ -597,13 +605,13 @@ def gf_matmul_pallas(
                     None, label="the measured default",
                 )
     if tile is None:
-        tile = DEFAULT_TILE if interpret else (DEEP_TILE if deep else TPU_TILE)
+        tile = DEFAULT_TILE if interpret else TPU_TILE
     acc_explicit = acc_dtype is not None
     if acc_dtype is None:
         if expand == "shift_raw" and w == 16:
             acc_dtype = jnp.int8
         else:
-            acc_dtype = jnp.bfloat16 if (interpret or deep) else jnp.int8
+            acc_dtype = jnp.bfloat16 if interpret else jnp.int8
     if expand == "shift_raw" and w == 16 and acc_dtype != jnp.int8:
         # Unmasked 16-bit planes reach 65535; bf16 represents integers
         # exactly only up to 2^8, so rounding would corrupt the parity.
@@ -656,12 +664,13 @@ def gf_matmul_pallas(
         # w=8: it lowers after the int32 cast-chain fix and wins at every
         # probed w=8 shape — k64 132.0 vs 119.4, decode p=k=10 80.5 vs
         # 48.4, headline k10 102.5 vs 60.0 (expand_r4b_*dot/
-        # expand_r4c_*dot captures, 2026-07-31).  Other widths stay on
-        # "sum" until a width-specific capture lands: the only w=16+dot
-        # hardware attempt (r4c w16_raw_dot) died at the 900 s timeout
-        # with the tunnel wedging right after — hang-vs-tunnel unresolved,
-        # and an unvalidated default that can hang must not ship
-        # (tools/tpu_probe_r5.sh re-probes it).
+        # expand_r4c_*dot captures, 2026-07-31).  w=16 stays on "sum":
+        # dot there is BIMODAL at fixed shape (82-148 GB/s across runs
+        # at mb=128) where sum is stable at ~102
+        # (w16_cross_*_tpu_20260801T* — and the r4c "hang" was the
+        # tunnel, both re-probes rc=0); a default that can regress
+        # below the stable alternative on half its dispatches does not
+        # ship.  RS_PALLAS_REFOLD=dot opts in.
         default_refold = "dot" if w == 8 else "sum"
         refold = os.environ.get("RS_PALLAS_REFOLD") or default_refold
         if refold not in ("sum", "dot"):

@@ -7,11 +7,11 @@ matrix.cu:667-744 / blocked experiment decode-gj.cu:1059-1201).  This tool
 measures that amortisation: B random invertible k x k GF(2^8) survivor
 submatrices inverted (a) on device in one dispatch — pivoting and
 (round 5) scan-free no-pivot variants, (b) on host one ``invert_matrix``
-call at a time — the paths repair_fleet chooses between.  The no-pivot
-variant drops the per-step argmax + permutation gather that made the
-pivoting dispatch LOSE to the host loop at k=128 on v5e
-(inverse_tpu_20260731T032339Z.jsonl); this tool's captures set or retire
-api._DEVICE_INVERT_MAX_K_TPU from measurement.
+call at a time — the paths repair_fleet chooses between.  The r5 capture
+(inverse_nopivot_tpu_20260801T001751Z.jsonl) REFUTED the theory that the
+per-step argmax caused the k=128 loss: no-pivot == pivoting on TPU at
+every cell (the elimination scan itself is the cost).  Its k x batch
+grid is the measurement behind api._device_invert_min_batch_tpu.
 
 Usage: python -m gpu_rscode_tpu.tools.inverse_bench [--batch 256] [--k 32]
 Prints one JSON line per (batch, k) combination (commented-jsonl capture

@@ -900,9 +900,10 @@ def test_repair_fleet_mixed_widths(tmp_path):
 
 
 def test_repair_fleet_deep_k_routes_to_host_on_tpu(tmp_path, monkeypatch):
-    """Measured routing (bench_captures/inverse_tpu_20260731T*): on TPU
-    backends the batched device inverter loses above k=32, so deep-k
-    groups take the per-archive host path instead of the device batch."""
+    """Measured routing (bench_captures/inverse_nopivot_tpu_20260801T*):
+    on TPU backends the batched device inverter loses at every measured
+    k=128 batch, so depths where _device_invert_min_batch_tpu returns
+    None take the per-archive host path instead of the device batch."""
     from gpu_rscode_tpu.ops import inverse as inverse_mod
     from gpu_rscode_tpu.utils import backend as backend_mod
     import gpu_rscode_tpu.api as api_mod
@@ -914,11 +915,16 @@ def test_repair_fleet_deep_k_routes_to_host_on_tpu(tmp_path, monkeypatch):
     }
     os.remove(chunk_file_name(path, 1))
 
-    # Pretend this is a TPU backend with the threshold below k=4, but keep
-    # the GEMM on the CPU-safe bitplane strategy (the interpret gate is
-    # pallas-only, so tpu_devices_present=True must not reach a compile).
+    # Pretend this is a TPU backend where k=4 counts as "deep" (the
+    # routing function returns None), but keep the GEMM on the CPU-safe
+    # bitplane strategy (the interpret gate is pallas-only, so
+    # tpu_devices_present=True must not reach a compile).
     monkeypatch.setattr(backend_mod, "tpu_devices_present", lambda: True)
-    monkeypatch.setattr(api_mod, "_DEVICE_INVERT_MAX_K_TPU", 2)
+    monkeypatch.setattr(
+        api_mod,
+        "_device_invert_min_batch_tpu",
+        lambda k: None if k > 2 else 1,
+    )
 
     def forbidden_batch(Ms, w=8):
         raise AssertionError(
@@ -935,10 +941,11 @@ def test_repair_fleet_deep_k_routes_to_host_on_tpu(tmp_path, monkeypatch):
 
 
 def test_repair_fleet_small_batch_routes_to_host_on_tpu(tmp_path, monkeypatch):
-    """Measured routing (ADVICE r4 / inverse_tpu_20260731T*): the device
-    dispatch loses at small batches for every k (0.2x at batch=64), and a
-    typical scrub damages few archives per (k, w) group — so groups below
-    _DEVICE_INVERT_MIN_BATCH_TPU take the host path on TPU backends."""
+    """Measured routing (ADVICE r4 / inverse_nopivot_tpu_20260801T*): the
+    device dispatch loses at small batches for every k (the ~0.14 s flat
+    dispatch floor), and a typical scrub damages few archives per (k, w)
+    group — so groups below _device_invert_min_batch_tpu(k) take the host
+    path on TPU backends."""
     from gpu_rscode_tpu.ops import inverse as inverse_mod
     from gpu_rscode_tpu.utils import backend as backend_mod
     import gpu_rscode_tpu.api as api_mod
@@ -948,7 +955,8 @@ def test_repair_fleet_small_batch_routes_to_host_on_tpu(tmp_path, monkeypatch):
     os.remove(chunk_file_name(path, 1))
 
     monkeypatch.setattr(backend_mod, "tpu_devices_present", lambda: True)
-    assert api_mod._DEVICE_INVERT_MAX_K_TPU >= 4  # k passes; batch gates
+    # k=4 is device-eligible; the 1-archive group is below the batch gate.
+    assert (api_mod._device_invert_min_batch_tpu(4) or 2) > 1
 
     def forbidden_batch(Ms, w=8, **kw):
         raise AssertionError(
@@ -963,8 +971,10 @@ def test_repair_fleet_small_batch_routes_to_host_on_tpu(tmp_path, monkeypatch):
 
 def test_repair_fleet_device_batch_uses_nopivot(tmp_path, monkeypatch):
     """When the device batch IS dispatched it must run the scan-free
-    elimination (pivot=False) — the verify-and-fallback below it makes that
-    safe, and the pivot scan is the measured k=128 loss."""
+    elimination (pivot=False) — the verify-and-fallback below it makes
+    that safe; on TPU it is perf-neutral vs pivoting (the r5 capture
+    refuted the pivot-scan theory of the k=128 loss) and on CPU it wins,
+    so it stays the dispatch."""
     from gpu_rscode_tpu.ops import inverse as inverse_mod
 
     paths = []

@@ -332,7 +332,7 @@ def test_production_defaults(monkeypatch):
     assert seen[-1]["expand"] == "shift"
 
 
-def test_depth_aware_tpu_defaults(monkeypatch):
+def test_uniform_tpu_defaults(monkeypatch):
     """On a TPU backend the tile/acc defaults split on contraction depth
     k*w (committed capture k_sweep_tpu_20260731T010808Z.jsonl): int8@16384
     below depth 256, bf16@32768 at/above.  Spied at the _pallas_matmul
@@ -350,10 +350,12 @@ def test_depth_aware_tpu_defaults(monkeypatch):
     )
     gf = get_field(8)
     rng = np.random.default_rng(27)
+    # int8@TPU_TILE at every depth: the post-flip k-sweep
+    # (k_sweep_postflip_tpu_20260801T*) retired the bf16 deep split.
     for k, want_tile, want_acc in [
         (10, pg.TPU_TILE, jnp.int8),          # depth 80
-        (32, pg.DEEP_TILE, jnp.bfloat16),     # depth 256
-        (64, pg.DEEP_TILE, jnp.bfloat16),     # depth 512
+        (32, pg.TPU_TILE, jnp.int8),          # depth 256
+        (64, pg.TPU_TILE, jnp.int8),          # depth 512
     ]:
         A = rng.integers(0, 256, size=(4, k), dtype=np.uint8)
         B = rng.integers(0, 256, size=(k, 512), dtype=np.uint8)

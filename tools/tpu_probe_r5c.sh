@@ -1,0 +1,63 @@
+#!/bin/bash
+# Round-5 follow-up captures raised by the session-2 results:
+#   1. w16 refold crossover — the r5 set resolved the "hang" (it was the
+#      tunnel: both small-shape runs returned rc=0) but showed the w16
+#      refold optimum is SHAPE-dependent: sum wins at 32 MB (19.2 vs 8.2)
+#      while dot wins at 320 MB (147.0 vs 101.9,
+#      w16_raw_dot_full_tpu_20260801T001620Z).  Probe 64/128/192 MB for
+#      both refolds to place the crossover before flipping any default.
+#   2. dma_floor re-measure — the post-flip floors run read 125.1 GB/s
+#      where the r3 capture read 286 at the same 320 MB shape; one is
+#      chip/tunnel state.  Three spaced re-reads disambiguate.
+# Waits for the main r5 set (one tunnel client at a time).
+# Usage: tools/tpu_probe_r5c.sh [max_seconds]
+set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+. "$LIB"
+
+while pgrep -f "tpu_probe_r5.sh" >/dev/null 2>&1; do
+  echo "# waiting for the main r5 capture set t=$((SECONDS - START))s" >&2
+  sleep 60
+  [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
+done
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; starting r5c follow-up set" >&2
+
+    W16=(python -m gpu_rscode_tpu.tools.w16_bench --trials 2)
+    for mb in 64 128 192; do
+      capture "w16_cross_sum_mb${mb}" 420 \
+        env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=sum \
+        "${W16[@]}" --mb "$mb"
+      capture "w16_cross_dot_mb${mb}" 420 \
+        env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=dot \
+        "${W16[@]}" --mb "$mb"
+    done
+
+    for i in 1 2 3; do
+      capture "dma_floor_recheck_$i" 600 \
+        python -m gpu_rscode_tpu.tools.kernel_sweep \
+        --mb 320 --trials 3 --bodies raw_dot --tiles 32768
+      sleep 30
+    done
+
+    echo "# r5c follow-up set complete" >&2
+    exit 0
+  fi
+  sleep 60
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
