@@ -87,16 +87,20 @@ DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
 # ungated device dispatch (14-136x at every measured point,
 # inverse_cpu_20260730T174508Z.jsonl).
 def _device_invert_min_batch_tpu(k: int) -> int | None:
-    """Smallest group size at which the batched device inverter measured
-    faster than the per-archive host loop on TPU, or None if the host
-    path won at every measured batch for this depth."""
-    if k <= 16:
-        return 1024
-    if k <= 48:
-        return 256
-    if k <= 64:
+    """Group-size threshold for the batched device inverter on TPU.
+
+    At the measured depths (k = 10/32/64/128) the value is the smallest
+    batch where the device dispatch beat the per-archive host loop (None
+    where the host won every cell); unmeasured intermediate depths take
+    the STRICTER neighbouring threshold — e.g. k=20 requires 1024, not
+    k=32's 256, because (k=10, b=256) measured a 0.81x LOSS."""
+    if k > 64:
+        return None
+    if k == 64:
         return 64
-    return None
+    if k >= 32:
+        return 256
+    return 1024
 
 
 def _segment_cols(chunk_size: int, native_num: int, segment_bytes: int) -> int:

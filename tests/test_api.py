@@ -955,8 +955,10 @@ def test_repair_fleet_small_batch_routes_to_host_on_tpu(tmp_path, monkeypatch):
     os.remove(chunk_file_name(path, 1))
 
     monkeypatch.setattr(backend_mod, "tpu_devices_present", lambda: True)
-    # k=4 is device-eligible; the 1-archive group is below the batch gate.
-    assert (api_mod._device_invert_min_batch_tpu(4) or 2) > 1
+    # k=4 must be device-eligible (min batch not None) so that the
+    # 1-archive group is rejected by the BATCH gate specifically.
+    min_batch = api_mod._device_invert_min_batch_tpu(4)
+    assert min_batch is not None and min_batch > 1
 
     def forbidden_batch(Ms, w=8, **kw):
         raise AssertionError(

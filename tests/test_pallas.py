@@ -333,11 +333,12 @@ def test_production_defaults(monkeypatch):
 
 
 def test_uniform_tpu_defaults(monkeypatch):
-    """On a TPU backend the tile/acc defaults split on contraction depth
-    k*w (committed capture k_sweep_tpu_20260731T010808Z.jsonl): int8@16384
-    below depth 256, bf16@32768 at/above.  Spied at the _pallas_matmul
-    boundary with a faked TPU presence — every combination is bit-exact,
-    so output equality cannot prove which default was chosen."""
+    """On a TPU backend the tile/acc default is int8@TPU_TILE at EVERY
+    contraction depth — the post-flip k-sweep (committed capture
+    k_sweep_postflip_tpu_20260801T002730Z.jsonl) retired the earlier
+    bf16@32768 deep split.  Spied at the _pallas_matmul boundary with a
+    faked TPU presence — every combination is bit-exact, so output
+    equality cannot prove which default was chosen."""
     import jax.numpy as jnp
 
     from gpu_rscode_tpu.ops import pallas_gemm as pg

@@ -20,7 +20,7 @@ START=$SECONDS
 ATTEMPT=0
 . "$LIB"
 
-while pgrep -f "tpu_probe_r5.sh" >/dev/null 2>&1; do
+while pgrep -f "tpu_probe_r5b?[.]sh" >/dev/null 2>&1; do
   echo "# waiting for the main r5 capture set t=$((SECONDS - START))s" >&2
   sleep 60
   [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
