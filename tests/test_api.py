@@ -940,6 +940,55 @@ def test_repair_fleet_deep_k_routes_to_host_on_tpu(tmp_path, monkeypatch):
         assert open(chunk_file_name(path, i), "rb").read() == golden[i]
 
 
+def test_device_invert_routing_matches_committed_capture():
+    """Evidence lock: _device_invert_min_batch_tpu must agree with the
+    committed k x batch grid it cites
+    (bench_captures/inverse_nopivot_tpu_20260801T001751Z.jsonl).  Every
+    measured cell the function routes to the DEVICE must have measured a
+    device win (speedup >= 1), and a depth the function host-routes
+    entirely (None) must have lost every measured cell — so the
+    thresholds cannot drift from the capture without re-measurement."""
+    import json
+    import pathlib
+
+    import gpu_rscode_tpu.api as api_mod
+
+    cap = (
+        pathlib.Path(__file__).resolve().parents[1]
+        / "bench_captures"
+        / "inverse_nopivot_tpu_20260801T001751Z.jsonl"
+    )
+    cells = [
+        json.loads(line)
+        for line in cap.read_text().splitlines()
+        if line.startswith("{")
+    ]
+    assert len(cells) >= 12  # the 4x4 grid minus any wedged tail
+    by_k: dict[int, dict[int, float]] = {}
+    for c in cells:
+        by_k.setdefault(c["k"], {})[c["batch"]] = c["speedup_vs_host_loop"]
+    for k, batches in by_k.items():
+        min_batch = api_mod._device_invert_min_batch_tpu(k)
+        if min_batch is None:
+            assert all(s < 1.0 for s in batches.values()), (k, batches)
+        else:
+            device_cells = {
+                b: s for b, s in batches.items() if b >= min_batch
+            }
+            assert device_cells, (k, min_batch, batches)
+            assert all(s >= 1.0 for s in device_cells.values()), (
+                k, min_batch, device_cells,
+            )
+            # Pin the threshold from below too: the largest measured
+            # batch the function host-routes must have measured a LOSS,
+            # else the threshold drifted upward past a measured win.
+            host_cells = [b for b in batches if b < min_batch]
+            if host_cells:
+                assert batches[max(host_cells)] < 1.0, (
+                    k, min_batch, batches,
+                )
+
+
 def test_repair_fleet_small_batch_routes_to_host_on_tpu(tmp_path, monkeypatch):
     """Measured routing (ADVICE r4 / inverse_nopivot_tpu_20260801T*): the
     device dispatch loses at small batches for every k (the ~0.14 s flat

@@ -332,6 +332,45 @@ def test_production_defaults(monkeypatch):
     assert seen[-1]["expand"] == "shift"
 
 
+def test_uniform_tpu_defaults_match_committed_capture():
+    """Evidence lock: the int8-at-every-depth default must agree with the
+    committed post-flip k-sweep it cites
+    (bench_captures/k_sweep_postflip_tpu_20260801T002730Z.jsonl): at every
+    swept k, the best int8 cell must beat the best bf16 cell, and the
+    shipped TPU_TILE must be within 10 % of that k's best tile — so the
+    defaults cannot drift from the capture without re-measurement."""
+    import json
+    import pathlib
+    import re
+
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    cap = (
+        pathlib.Path(__file__).resolve().parents[1]
+        / "bench_captures"
+        / "k_sweep_postflip_tpu_20260801T002730Z.jsonl"
+    )
+    cells: dict[int, dict[tuple[str, int], float]] = {}
+    pat = re.compile(r"k(\d+)_acc-(int8|bf16)@(\d+)")
+    for line in cap.read_text().splitlines():
+        if not line.startswith("{"):
+            continue
+        for key, val in json.loads(line).items():
+            m = pat.fullmatch(key)
+            if m and isinstance(val, float):
+                cells.setdefault(int(m.group(1)), {})[
+                    (m.group(2), int(m.group(3)))
+                ] = val
+    assert set(cells) == {4, 10, 32, 64, 128}
+    for k, grid in cells.items():
+        best_int8 = max(v for (a, _), v in grid.items() if a == "int8")
+        best_bf16 = max(v for (a, _), v in grid.items() if a == "bf16")
+        assert best_int8 > best_bf16, (k, grid)
+        int8_at_default = grid.get(("int8", pg.TPU_TILE))
+        assert int8_at_default is not None, (k, pg.TPU_TILE)
+        assert int8_at_default >= 0.90 * best_int8, (k, grid)
+
+
 def test_uniform_tpu_defaults(monkeypatch):
     """On a TPU backend the tile/acc default is int8@TPU_TILE at EVERY
     contraction depth — the post-flip k-sweep (committed capture

@@ -1,11 +1,13 @@
 #!/bin/bash
 # Round-5 gap fillers: the post-flip tile sweep's two missing k=10 points
-# (65536, 8192 under shift_raw+dot+int8).  The first 65536 attempt hung at
+# (8192, 65536 under shift_raw+dot+int8).  The first 65536 attempt hung at
 # jax init / first compile and the tunnel wedged at ~2026-08-01 00:52 UTC
 # (tile_dot_k10_t65536_int8_tpu_20260801T005229Z.log shows no output past
 # the backend-init warning), so both points are unmeasured.  Low stakes:
 # the shipped default (16384) measured within noise of 32768 and these
-# only bound the tile curve's tails.
+# only bound the tile curve's tails.  Keeps retrying failed points across
+# healthy windows until both land or the deadline passes (a wedge mid-set
+# must not report success).
 # Usage: tools/tpu_probe_r5d.sh [max_seconds]
 set -u
 LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
@@ -22,6 +24,8 @@ while pgrep -f "tpu_probe_r5[bc]?[.]sh" >/dev/null 2>&1; do
   [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
 done
 
+done_8192=0
+done_65536=0
 while [ $((SECONDS - START)) -lt "$MAX" ]; do
   ATTEMPT=$((ATTEMPT + 1))
   echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
@@ -31,15 +35,26 @@ import jax
 sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
 EOF
   then
-    echo "# tunnel healthy; starting r5d gap fillers" >&2
+    echo "# tunnel healthy; r5d gap fillers (8192=$done_8192 65536=$done_65536)" >&2
     P=(python -m gpu_rscode_tpu.tools.expand_probe --trials 3
        --expand shift_raw --refold dot --acc int8)
-    capture tile_dot_k10_t8192_int8_retry 600 "${P[@]}" --tile 8192
-    capture tile_dot_k10_t65536_int8_retry 600 "${P[@]}" --tile 65536
-    echo "# r5d gap fillers complete" >&2
-    exit 0
+    if [ "$done_8192" -eq 0 ]; then
+      capture tile_dot_k10_t8192_int8_retry 600 "${P[@]}" --tile 8192 \
+        && done_8192=1
+    fi
+    if [ "$done_65536" -eq 0 ]; then
+      capture tile_dot_k10_t65536_int8_retry 600 "${P[@]}" --tile 65536 \
+        && done_65536=1
+    fi
+    if [ "$done_8192" -eq 1 ] && [ "$done_65536" -eq 1 ]; then
+      echo "# r5d gap fillers complete" >&2
+      exit 0
+    fi
+    echo "# incomplete set (wedge?); backing off before retry" >&2
+    sleep 300
+  else
+    sleep 120
   fi
-  sleep 120
 done
-echo "# deadline reached without healthy tunnel" >&2
+echo "# deadline reached; landed 8192=$done_8192 65536=$done_65536" >&2
 exit 2
