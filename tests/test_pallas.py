@@ -264,6 +264,26 @@ def test_refold_env_override(monkeypatch):
     assert seen[-1]["refold"] == "dot"
 
 
+def test_refold_default_per_width(monkeypatch):
+    """The refold default is 'dot' at w=8 (wins every probed shape) and
+    'sum' at w=16 — w16+dot measured BIMODAL at fixed shape (82-148 GB/s
+    vs sum's stable ~102, w16_cross_*_tpu_20260801T*), so the stable
+    refold ships and dot stays opt-in via RS_PALLAS_REFOLD."""
+    seen = []
+    _spy_matmul(monkeypatch, seen)
+    rng = np.random.default_rng(31)
+    for w, want_refold in ((8, "dot"), (16, "sum")):
+        gf = get_field(w)
+        hi = 256 if w == 8 else 65536
+        dt = np.uint8 if w == 8 else np.uint16
+        A = rng.integers(0, hi, size=(2, 4)).astype(dt)
+        B = rng.integers(0, hi, size=(4, 512)).astype(dt)
+        np.testing.assert_array_equal(
+            np.asarray(gf_matmul_pallas(A, B, w=w)), gf.matmul(A, B)
+        )
+        assert seen[-1]["refold"] == want_refold, (w, seen[-1])
+
+
 def test_tile_env_override(monkeypatch):
     """RS_PALLAS_TILE sets the kernel column tile (the true analog of the
     reference's -p gridDim.x cap — the CLI's -p sizes segments instead);
