@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5e: map the w16+dot bimodality (82-148 GB/s across same-shape
+# processes; sum is stable ~102 — w16_cross_*_tpu_20260801T*).  Each slow
+# reading was a best-of-trials WITHIN one process, so the mode is set at
+# (re)compile time, not per-dispatch.  This probe asks whether the mode is
+# tile-dependent: 2 separate processes per tile in {8192, 16384, 32768}
+# at mb=128.  A tile that lands fast on both runs is a candidate stable
+# default that would ship ~147 GB/s for GF(2^16); all-tiles-bimodal pins
+# the cause on remote-toolchain compile nondeterminism (document, keep
+# sum).  Runs after the r5d gap fillers.
+# Usage: tools/tpu_probe_r5e.sh [max_seconds]
+set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+. "$LIB"
+
+while pgrep -f "tpu_probe_r5[bcd]?[.]sh" >/dev/null 2>&1; do
+  echo "# waiting for earlier r5 watchers t=$((SECONDS - START))s" >&2
+  sleep 60
+  [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
+done
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; starting w16 bimodality tile map" >&2
+    for tile in 8192 16384 32768; do
+      for rep in a b; do
+        capture "w16_bimodal_t${tile}_${rep}" 420 \
+          env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=dot \
+          RS_PALLAS_TILE="$tile" \
+          python -m gpu_rscode_tpu.tools.w16_bench --trials 2 --mb 128
+      done
+    done
+    echo "# r5e bimodality map complete" >&2
+    exit 0
+  fi
+  sleep 120
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
