@@ -133,7 +133,12 @@ def main() -> int:
         if stripe_sharded:
             m2 = max(1, args.mb[1] * 1024 * 1024 // k // 128) * 128
             m_loc = m2 // (n_dev // stripe_n)
-            row["psum_bytes_per_seg_per_dev"] = int(p * 8 * 4 * m_loc)
+            # int8 pre-parity planes since round 5 (parallel/sharded.py
+            # narrows the collective; mod-256 wrap is parity-exact) —
+            # p*w*1 bytes per column.  The 2026-07-31 capture of this
+            # tool predates the narrowing and reported the int32 form
+            # (4x this number).
+            row["psum_bytes_per_seg_per_dev"] = int(p * 8 * m_loc)
         rows.append(row)
         print(json.dumps(row), flush=True)
 
