@@ -25,12 +25,13 @@ reference's grid-stride column sweep, matrix.cu:265-322).  Out-of-range
 columns in the last tile compute garbage on garbage and are dropped by the
 masked output write Pallas performs automatically.
 
-Three bit-expansion formulations (``expand``), all bit-verified in interpret
-mode; the committed 2026-07-30 v5e captures (bench_captures/) show the kernel
-is compute-bound on the expansion — compute-only ceiling 64.9 GB/s vs a DMA
-floor of 286 GB/s (both at 320 MB calls, kernel_floors_tpu_*.jsonl), kernel
-end-to-end 64.3-64.8 GB/s at tile 16384
-(bench_tpu_*.json, tile_pick_tpu_*.jsonl):
+Three bit-expansion formulations (``expand``), all bit-verified in
+interpret mode.  The kernel is compute-bound in every measured era: the
+2026-07-30 captures had it at ~99 % of a 64.9 GB/s compute-only ceiling,
+and after the round-4/5 algebraic reductions the post-flip floors
+(kernel_floors_postflip_tpu_20260801T*) put it at ~97 % of a ~110 GB/s
+ceiling, with the DMA floor far above (>= 170; readings scatter 125-333
+across tunnel sessions, dma_floor_recheck_*):
 
 * ``"shift"`` — plane s = (b >> s) & 1 in int32 lanes (proven default).
 * ``"sign"``  — plane s = (int_w)(b << (w-1-s)) >> (w-1), i.e. {0, -1},
@@ -48,8 +49,10 @@ bench_captures/expand_r4b_* / expand_r4c_*): the production default is
 ``expand="shift_raw"`` plus, at w=8, ``refold="dot"`` — the mask-free
 expansion beat ``shift`` at every probed shape, and moving the parity
 refold onto the MXU beat the VPU shift-sum at every probed w=8 shape.
-Headline (k=10, p=4): 102.5 GB/s (was 64.7 under shift+sum); k=64: 132.0;
-k=128: 133.6; decode shape p=k=10: 80.5.  w=16 measured 101.9 under
+Headline (k=10, p=4): 105.5 GB/s end-to-end encode / 105.6 decode with
+3.18 ms 4-erasure recovery (bench_tpu_20260801T000810Z — was 64.7/64.7
+under shift+sum); raw GEMM 109.8 @ k=10, 152.5 @ k=32, 159.8 @ k=64,
+167.4 @ k=128 (post-flip k-sweep).  w=16 measured 101.9 under
 shift_raw (was 90.3 under shift) with the "sum" refold.  The r4c
 w16+dot timeout was the TUNNEL, not a hang (resolved 2026-08-01: both
 small-shape re-probes returned rc=0, w16_small_*_tpu_20260801T*) —
