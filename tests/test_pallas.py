@@ -159,15 +159,16 @@ def test_pallas_sign_int8_acc(expand):
 
 
 @pytest.mark.parametrize("expand", ["shift", "shift_raw"])
-@pytest.mark.parametrize("w", [8, 16])
+@pytest.mark.parametrize("w", [4, 8, 16])
 def test_pallas_dot_refold(expand, w):
     """refold='dot' (MXU parity refold via the (p, p*w) bit-weight
-    operator) is bit-exact at both widths; powers of two are exact in
-    bf16 and the folded values stay below 2^24 in f32."""
+    operator) is bit-exact at every legacy width w in {4, 8, 16}
+    (gf.h's field set); powers of two are exact in bf16 and the folded
+    values stay below 2^24 in f32."""
     import jax.numpy as jnp
 
     gf = get_field(w)
-    dt = np.uint8 if w == 8 else np.uint16
+    dt = np.uint8 if w <= 8 else np.uint16
     rng = np.random.default_rng(29)
     A = rng.integers(0, 1 << w, size=(4, 6), dtype=dt)
     B = rng.integers(0, 1 << w, size=(6, 640), dtype=dt)
