@@ -356,7 +356,10 @@ def _pallas_matmul_pack2(A, B, w, tile, interpret):
         B = jnp.pad(B, ((0, 0), (0, 1)))
     m2 = (m + pad) // 2
     B16 = jax.lax.bitcast_convert_type(B.reshape(k, m2, 2), jnp.uint16)
+    # Same alignment rule as _pallas_matmul: the halved tile must stay
+    # lane-aligned (tile//2 of an odd-128-multiple tile is not).
     tile2 = min(tile // 2, ((m2 + 127) // 128) * 128)
+    tile2 = ((tile2 + 127) // 128) * 128
     grid = (pl.cdiv(m2, tile2),)
     out16 = pl.pallas_call(
         functools.partial(_kernel_pack2, w=w, k=k, p=p),
@@ -401,9 +404,14 @@ def _pallas_matmul(
         a_cols = k * w
     a_bits = a_op.astype(jnp.int8 if acc_dtype == jnp.int8 else acc_dtype)
     out_dtype = jnp.uint8 if gf.dtype == np.uint8 else jnp.uint16
-    # Clamp to m rounded up to the lane width so the block shape stays
-    # 128-aligned for any m; the last tile's overhang is masked by Pallas.
+    # Clamp to m rounded up to the lane width, then round the tile itself
+    # up to the lane width, so the block shape stays 128-aligned for ANY
+    # tile origin (defaults, RS_PALLAS_TILE, explicit arguments, pack2's
+    # halving); the last tile's overhang is masked by Pallas.  A
+    # misaligned block would fail Mosaic lowering on hardware and
+    # silently demote every dispatch to the bitplane path.
     tile = min(tile, ((m + 127) // 128) * 128)
+    tile = ((tile + 127) // 128) * 128
     grid = (pl.cdiv(m, tile),)
     out_rows = p if fold else p * w
     in_specs = [
@@ -607,6 +615,20 @@ def gf_matmul_pallas(
                     f"RS_PALLAS_TILE={env!r} is not a positive integer",
                     None, label="the measured default",
                 )
+            if tile is not None and tile % 128:
+                # TPU blocks must be lane-aligned; a misaligned tile
+                # would fail Mosaic lowering and silently demote every
+                # dispatch to the bitplane path.  Round up, warn — the
+                # same warn-and-continue hygiene as the other env knobs.
+                aligned = ((tile + 127) // 128) * 128
+                import warnings
+
+                warnings.warn(
+                    f"RS_PALLAS_TILE={tile} is not a multiple of the "
+                    f"128-lane width; rounding up to {aligned}",
+                    stacklevel=2,
+                )
+                tile = aligned
     if tile is None:
         tile = DEFAULT_TILE if interpret else TPU_TILE
     acc_explicit = acc_dtype is not None

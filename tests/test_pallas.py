@@ -306,6 +306,12 @@ def test_tile_env_override(monkeypatch):
         np.asarray(gf_matmul_pallas(A, B, tile=512)), want
     )
     assert seen[-1]["tile"] == 512  # explicit argument beats the env
+    monkeypatch.setenv("RS_PALLAS_TILE", "200")
+    with pytest.warns(UserWarning, match="128-lane"):
+        np.testing.assert_array_equal(
+            np.asarray(gf_matmul_pallas(A, B)), want
+        )
+    assert seen[-1]["tile"] == 256  # misaligned env tile rounds up
     monkeypatch.setenv("RS_PALLAS_TILE", "zero")
     with pytest.warns(UserWarning, match="RS_PALLAS_TILE"):
         np.testing.assert_array_equal(
