@@ -190,6 +190,12 @@ def test_pallas_pack2():
         B = rng.integers(0, 256, size=(10, m), dtype=np.uint8)
         got = np.asarray(gf_matmul_pallas(A, B, expand="pack2", tile=2048))
         np.testing.assert_array_equal(got, gf.matmul(A, B))
+    # tile=384 is 128-aligned but its pack2 halving (192) is not; the
+    # consumption clamp must re-align it (the silent-demotion guard).
+    A = rng.integers(0, 256, size=(4, 10), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(10, 4097), dtype=np.uint8)
+    got = np.asarray(gf_matmul_pallas(A, B, expand="pack2", tile=384))
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
     A, B = (rng.integers(0, 256, size=(4, 10), dtype=np.uint8),
             rng.integers(0, 256, size=(10, 256), dtype=np.uint8))
     with pytest.raises(ValueError, match="pre-parity"):
