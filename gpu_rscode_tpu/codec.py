@@ -54,6 +54,20 @@ def _pallas_failure_types() -> tuple:
 from .utils.backend import tpu_devices_present as _tpu_devices_present
 
 
+def _gf_matmul_pallas_eager(A, B, w):
+    """Single-device fused-kernel dispatch, called EAGERLY (the inner
+    _pallas_matmul is itself jitted, so compute is identical to routing
+    through gf_matmul_jit): the RS_PALLAS_* env knobs then resolve on
+    concrete arrays, which is what lets RS_PALLAS_REFOLD=autotune time
+    real kernels — under an outer jit it would see tracers and fall back
+    to the static default (see pallas_gemm._autotune_refold).  Module-
+    level hook (import deferred to first use, like _pallas_failure_types)
+    so tests can inject kernel failures here."""
+    from .ops.pallas_gemm import gf_matmul_pallas
+
+    return gf_matmul_pallas(A, B, w)
+
+
 class RSCodec:
     """(n, k) Reed-Solomon codec over GF(2^w).
 
@@ -153,7 +167,7 @@ class RSCodec:
                 # it); subsequent segments run the already-proven executable
                 # fully async.
                 try:
-                    out = gf_matmul_jit(A, B, w=self.w, strategy="pallas")
+                    out = _gf_matmul_pallas_eager(A, B, self.w)
                     if not self._pallas_checked:
                         jax.block_until_ready(out)
                         self._pallas_checked = True

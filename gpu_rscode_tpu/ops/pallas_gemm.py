@@ -59,10 +59,20 @@ small-shape re-probes returned rc=0, w16_small_*_tpu_20260801T*) —
 but the r5c crossover sweep showed w16+dot is BIMODAL at fixed shape
 (mb=128: 84.8 / 82.3 / 147.6 across three runs; mb=64: 142.3; mb=320:
 147.0; mb=32: 8.2) where sum is stable (101.7-102.6 at every probed
-size, w16_cross_*_tpu_20260801T*).  A default that regresses below
-sum on roughly half its dispatches is not shippable, so w=16 keeps
-"sum"; RS_PALLAS_REFOLD=dot opts into the 147 GB/s fast mode for
-callers who can tolerate the variance.  ``"sign"`` and ``"nibble"``
+size, w16_cross_*_tpu_20260801T*).  The r5e tile map pinned the cause
+as NOT tile-dependent: at mb=128, tile 8192 read 136.9 then 52.4 and
+tile 16384 read 144.4 / 132.0 in-session against 84.8 / 82.3 / 147.6
+for the same shape in the prior session (w16_bimodal_t*_tpu_20260801T*)
+— every slow reading was a best-of-trials WITHIN one process, so the
+mode is fixed at (re)compile time, i.e. remote-toolchain compile
+nondeterminism, not a per-dispatch or per-tile effect.  A default that
+regresses below sum on a coin-flip compile is not shippable, so w=16
+keeps "sum"; RS_PALLAS_REFOLD=dot opts into the 132-147 GB/s fast mode
+for callers who can tolerate the variance, and RS_PALLAS_REFOLD=autotune
+times both variants once per compiled shape class and ships whichever
+mode THIS process's compile produced (fast-dot when the coin lands
+right, sum otherwise — the operational answer to nondeterminism a
+static default cannot give).  ``"sign"`` and ``"nibble"``
 do NOT
 lower on the current Mosaic toolchain (sign: ``arith.subi`` on int8
 vectors fails to legalize; nibble: 8-bit iota unsupported; reworked
@@ -447,6 +457,93 @@ def _pallas_matmul(
     )(*operands)
 
 
+# refold="autotune" decisions, keyed by the full dispatch configuration
+# (shapes + dtypes + kernel config).  The w16 bimodality evidence
+# (w16_bimodal_t*_tpu_20260801T*) shows the dot refold's fast/slow mode is
+# fixed at (re)compile time — every slow reading was a best-of-trials
+# WITHIN one process — so one timed calibration per compiled shape class
+# is sound for the process lifetime: XLA's jit cache keeps that exact
+# compilation alive, and a new shape class gets its own calibration.
+_AUTOTUNE_CACHE: dict = {}
+
+# Require a real win before preferring the variable mode: ties and noise
+# go to the stable "sum".  The measured gap is wide on both sides (dot
+# fast 132-147 vs sum ~102 vs dot slow 52-85 GB/s at w=16), so any
+# margin in (0.7, 1.0) separates the modes; 0.9 leaves room for tunnel
+# dispatch jitter.
+_AUTOTUNE_MARGIN = 0.9
+
+
+def _time_refold(run) -> float:
+    """Best-of-2 wall time of ``run()`` after a compile/warm-up call.
+
+    Separated out so tests can monkeypatch deterministic timings; the
+    warm-up call also surfaces Mosaic lowering failures before anything
+    is timed.
+    """
+    import time
+
+    jax.block_until_ready(run())
+    best = float("inf")
+    for _ in range(2):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _autotune_refold(A, B, w, tile, acc_dtype, interpret, expand) -> str:
+    """Resolve ``refold="autotune"`` to "sum" or "dot" by timing both
+    compiled kernels once on the actual operands.
+
+    Motivated by w=16, where the dot refold is bimodal ACROSS compiles
+    (remote-toolchain compile nondeterminism, not tile- or dispatch-
+    dependent — see the module docstring) so no static default can ship
+    its 132-147 GB/s fast mode safely; a per-process calibration can:
+    whichever mode this process compiled is the mode every subsequent
+    same-shape dispatch reuses.  Worst case (slow-mode compile or a dot
+    lowering failure) the choice falls back to the stable "sum", so the
+    floor is the static default's throughput minus one calibration.
+    """
+    key = (
+        A.shape, str(A.dtype), B.shape, str(B.dtype), w, tile,
+        str(acc_dtype), expand, interpret,
+    )
+    hit = _AUTOTUNE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    times = {}
+    for cand in ("sum", "dot"):
+        try:
+            times[cand] = _time_refold(
+                lambda: _pallas_matmul(
+                    A, B, w, tile, acc_dtype, interpret, expand,
+                    fold=True, refold=cand,
+                )
+            )
+        except Exception:
+            # A refold variant that fails to lower simply loses the
+            # race; if BOTH fail the caller's normal dispatch raises
+            # through the existing Mosaic-failure fallback.
+            times[cand] = float("inf")
+    choice = (
+        "dot"
+        if times["dot"] < _AUTOTUNE_MARGIN * times["sum"]
+        else "sum"
+    )
+    _AUTOTUNE_CACHE[key] = choice
+    return choice
+
+
+def _default_refold(w: int) -> str:
+    """The static per-width refold default: "dot" at w=8 (wins every
+    probed shape — expand_r4b/r4c captures), "sum" elsewhere (at w=16
+    dot is a compile-time coin flip; see the module docstring).  One
+    definition shared by the env-fallback, pre-parity and tracer-guard
+    resolution paths."""
+    return "dot" if w == 8 else "sum"
+
+
 def _default_expand(w: int, acc_dtype) -> str:
     """The production default that APPLIES at this (w, acc_dtype):
     shift_raw (faster at every probed shape — expand_r4b_*/expand_r4c_*
@@ -520,9 +617,13 @@ def gf_matmul_pallas(
     ``refold``: how the kernel folds accumulator parities back into GF
     elements — "dot" (MXU: one tiny bf16 matmul against the (p, p*w)
     bit-weight operator; exact in f32 for any supported w) or "sum"
-    (VPU: bits << s summed over w).  Default: "dot" at w=8 (the width
-    the captures validate), "sum" elsewhere until a width-specific
-    capture lands.  Env-overridable via RS_PALLAS_REFOLD.
+    (VPU: bits << s summed over w), or "autotune" — time both compiled
+    variants once on the actual operands and cache the winner per shape
+    class (ties/noise go to "sum"; intended for w=16, where the dot
+    refold's speed is a compile-time coin flip — see _autotune_refold).
+    Default: "dot" at w=8 (the width the captures validate), "sum"
+    elsewhere until a width-specific capture lands.  Env-overridable via
+    RS_PALLAS_REFOLD.
     ``interpret`` defaults to True off-TPU so the same code path runs under
     the CPU test mesh.
     """
@@ -696,14 +797,36 @@ def gf_matmul_pallas(
         # tunnel, both re-probes rc=0); a default that can regress
         # below the stable alternative on half its dispatches does not
         # ship.  RS_PALLAS_REFOLD=dot opts in.
-        default_refold = "dot" if w == 8 else "sum"
+        default_refold = _default_refold(w)
         refold = os.environ.get("RS_PALLAS_REFOLD") or default_refold
-        if refold not in ("sum", "dot"):
+        if refold not in ("sum", "dot", "autotune"):
             refold = _env_fallback(
                 f"RS_PALLAS_REFOLD={refold!r} is unknown", default_refold
             )
-    if refold not in ("sum", "dot"):
+    if refold not in ("sum", "dot", "autotune"):
         raise ValueError(f"unknown refold {refold!r}")
+    if refold == "autotune":
+        if not fold_parity:
+            # The pre-parity (stripe-psum) form has no refold stage to
+            # tune — the fold happens host-side after the collective.
+            refold = _default_refold(w)
+        elif isinstance(A, jax.core.Tracer) or isinstance(B, jax.core.Tracer):
+            # Inside a caller's jit trace the operands are tracers:
+            # block_until_ready is a no-op there, so "timing" would
+            # measure per-variant TRACE overhead and cache that garbage
+            # decision for every later eager call of the same shape.
+            # Calibration needs concrete arrays — fall back to the
+            # static per-width default with the module's usual warning.
+            refold = _env_fallback(
+                "refold='autotune' cannot calibrate under a jit trace "
+                "(operands are tracers); call the pallas path eagerly "
+                "to calibrate",
+                _default_refold(w),
+            )
+        else:
+            refold = _autotune_refold(
+                A, B, w, tile, acc_dtype, interpret, expand
+            )
     return _pallas_matmul(
         A, B, w, tile, acc_dtype, interpret, expand, fold=fold_parity,
         refold=refold,

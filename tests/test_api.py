@@ -444,14 +444,10 @@ def test_pallas_fallback_on_backend_error(monkeypatch):
     data = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
     expected = c.gf.matmul(c.parity_block, data)
 
-    real = codec_mod.gf_matmul_jit
+    def boom(A, B, w=8):
+        raise jax.errors.JaxRuntimeError("MOSAIC: backend exploded")
 
-    def boom(A, B, w=8, strategy="bitplane"):
-        if strategy == "pallas":
-            raise jax.errors.JaxRuntimeError("MOSAIC: backend exploded")
-        return real(A, B, w=w, strategy=strategy)
-
-    monkeypatch.setattr(codec_mod, "gf_matmul_jit", boom)
+    monkeypatch.setattr(codec_mod, "_gf_matmul_pallas_eager", boom)
     with warnings.catch_warnings(record=True) as caught:
         warnings.simplefilter("always")
         out = np.asarray(c.encode(data))
@@ -484,13 +480,38 @@ def test_pallas_fallback_does_not_swallow_program_errors(monkeypatch):
     c = RSCodec(4, 2, strategy="pallas")
     data = np.zeros((4, 512), dtype=np.uint8)
 
-    def boom(A, B, w=8, strategy="bitplane"):
+    def boom(A, B, w=8):
         raise ValueError("shape bug")
 
-    monkeypatch.setattr(codec_mod, "gf_matmul_jit", boom)
+    monkeypatch.setattr(codec_mod, "_gf_matmul_pallas_eager", boom)
     with pytest.raises(ValueError, match="shape bug"):
         c.encode(data)
     assert c.strategy == "pallas"  # not demoted
+
+
+def test_codec_pallas_dispatch_is_eager_for_autotune(monkeypatch):
+    """RS_PALLAS_REFOLD=autotune must CALIBRATE in the production codec
+    path — i.e. the single-device pallas dispatch runs eagerly so the env
+    resolution sees concrete arrays.  A refactor back to an outer jit
+    would silently turn autotune into the static default (the tracer
+    guard) and this pins it: the timer must actually run."""
+    from gpu_rscode_tpu.codec import RSCodec
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    timed = []
+    real = pg._time_refold
+    monkeypatch.setattr(
+        pg, "_time_refold", lambda run: timed.append(1) or real(run)
+    )
+    monkeypatch.setattr(pg, "_AUTOTUNE_CACHE", {})
+    monkeypatch.setenv("RS_PALLAS_REFOLD", "autotune")
+
+    c = RSCodec(4, 2, strategy="pallas")
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+    out = np.asarray(c.encode(data))
+    assert len(timed) == 2  # both refold variants were really timed
+    np.testing.assert_array_equal(out, c.gf.matmul(c.parity_block, data))
 
 
 # ----- chunk repair ---------------------------------------------------------

@@ -291,6 +291,150 @@ def test_refold_default_per_width(monkeypatch):
         assert seen[-1]["refold"] == want_refold, (w, seen[-1])
 
 
+def _fake_timer(monkeypatch, results):
+    """Replace _time_refold with a deterministic fake.  _autotune_refold
+    times candidates in the fixed order ("sum", "dot"), so `results` is
+    consumed positionally; an Exception instance raises instead (a
+    lowering failure surfaces during the warm-up call inside the real
+    timer).  Returns the call log."""
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    calls = []
+
+    def fake(run):
+        calls.append(run)
+        r = results[len(calls) - 1]
+        if isinstance(r, Exception):
+            raise r
+        return r
+
+    monkeypatch.setattr(pg, "_time_refold", fake)
+    monkeypatch.setattr(pg, "_AUTOTUNE_CACHE", {})
+    return calls
+
+
+def test_refold_autotune_decision(monkeypatch):
+    """refold='autotune' ships the dot refold only on a real measured win
+    (dot < margin * sum — ties and jitter go to the stable 'sum'), and the
+    decision is cached per shape class so only the first dispatch pays the
+    calibration.  Motivation: the w16 dot mode is a compile-time coin flip
+    (w16_bimodal_t*_tpu_20260801T*), so no static default can ship its
+    fast mode — a per-process calibration can."""
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    rng = np.random.default_rng(33)
+    A = rng.integers(0, 65536, size=(2, 4)).astype(np.uint16)
+    B = rng.integers(0, 65536, size=(4, 512)).astype(np.uint16)
+    gf = get_field(16)
+    want = gf.matmul(A, B)
+
+    # Fast-dot compile: dot well under margin*sum -> dot ships.
+    calls = _fake_timer(monkeypatch, [1.0, 0.5])
+    seen = []
+    _spy_matmul(monkeypatch, seen)
+    np.testing.assert_array_equal(
+        np.asarray(gf_matmul_pallas(A, B, w=16, refold="autotune")), want
+    )
+    assert seen[-1]["refold"] == "dot"
+    assert len(calls) == 2
+    # Cached: the second identical dispatch does not re-time.
+    np.testing.assert_array_equal(
+        np.asarray(gf_matmul_pallas(A, B, w=16, refold="autotune")), want
+    )
+    assert len(calls) == 2 and seen[-1]["refold"] == "dot"
+
+    # Slow-dot compile (within margin of sum): the stable refold ships.
+    calls = _fake_timer(monkeypatch, [1.0, 0.95])
+    np.testing.assert_array_equal(
+        np.asarray(gf_matmul_pallas(A, B, w=16, refold="autotune")), want
+    )
+    assert seen[-1]["refold"] == "sum" and len(calls) == 2
+
+    # A dot lowering failure just loses the race (the real timer's warm-up
+    # call raises before timing).
+    calls = _fake_timer(monkeypatch, [1.0, RuntimeError("mosaic refused")])
+    np.testing.assert_array_equal(
+        np.asarray(gf_matmul_pallas(A, B, w=16, refold="autotune")), want
+    )
+    assert seen[-1]["refold"] == "sum" and len(calls) == 2
+
+
+def test_refold_autotune_env_and_preparity(monkeypatch):
+    """RS_PALLAS_REFOLD=autotune routes the default resolution into the
+    calibrator; the pre-parity (fold_parity=False) form has no refold
+    stage, so autotune resolves to the per-width static default without
+    timing anything."""
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+    from gpu_rscode_tpu.ops.gemm import from_bitplanes
+
+    rng = np.random.default_rng(34)
+    A = rng.integers(0, 256, size=(2, 4), dtype=np.uint8)
+    B = rng.integers(0, 256, size=(4, 512), dtype=np.uint8)
+    gf = get_field(8)
+    want = gf.matmul(A, B)
+
+    calls = _fake_timer(monkeypatch, [1.0, 0.5])
+    seen = []
+    _spy_matmul(monkeypatch, seen)
+    monkeypatch.setenv("RS_PALLAS_REFOLD", "autotune")
+    np.testing.assert_array_equal(np.asarray(gf_matmul_pallas(A, B)), want)
+    assert seen[-1]["refold"] == "dot" and len(calls) == 2
+
+    acc = gf_matmul_pallas(A, B, fold_parity=False, refold="autotune")
+    np.testing.assert_array_equal(np.asarray(from_bitplanes(acc, 8)), want)
+    # No new timing calls; the pre-parity dispatch used the w=8 default.
+    assert len(calls) == 2 and seen[-1]["refold"] == "dot"
+
+
+def test_refold_autotune_under_jit_trace(monkeypatch):
+    """Inside a caller's jit trace the operands are tracers and
+    block_until_ready is a no-op — "timing" there would measure trace
+    overhead and cache a garbage decision for every later eager call of
+    the shape.  Autotune must refuse to calibrate under a trace: warn,
+    use the static per-width default, time nothing, cache nothing."""
+    import jax
+
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    calls = _fake_timer(monkeypatch, [1.0, 0.5])
+    seen = []
+    _spy_matmul(monkeypatch, seen, force_interpret=True)
+    rng = np.random.default_rng(36)
+    A = rng.integers(0, 65536, size=(2, 4)).astype(np.uint16)
+    B = rng.integers(0, 65536, size=(4, 512)).astype(np.uint16)
+    gf = get_field(16)
+
+    jitted = jax.jit(
+        lambda a, b: gf_matmul_pallas(a, b, w=16, refold="autotune")
+    )
+    with pytest.warns(UserWarning, match="cannot calibrate"):
+        got = np.asarray(jitted(A, B))
+    np.testing.assert_array_equal(got, gf.matmul(A, B))
+    assert seen[-1]["refold"] == "sum"  # static w=16 default, not "dot"
+    assert not calls and not pg._AUTOTUNE_CACHE
+
+
+def test_refold_autotune_real_calibration():
+    """End-to-end (no fakes): a real timed calibration in interpret mode
+    picks one of the two variants and the output is bit-exact either way
+    — correctness must not depend on which mode wins the race."""
+    from gpu_rscode_tpu.ops import pallas_gemm as pg
+
+    pg._AUTOTUNE_CACHE.clear()
+    rng = np.random.default_rng(35)
+    for w in (8, 16):
+        gf = get_field(w)
+        hi = 256 if w == 8 else 65536
+        dt = np.uint8 if w == 8 else np.uint16
+        A = rng.integers(0, hi, size=(2, 4)).astype(dt)
+        B = rng.integers(0, hi, size=(4, 512)).astype(dt)
+        np.testing.assert_array_equal(
+            np.asarray(gf_matmul_pallas(A, B, w=w, refold="autotune")),
+            gf.matmul(A, B),
+        )
+    pg._AUTOTUNE_CACHE.clear()
+
+
 def test_tile_env_override(monkeypatch):
     """RS_PALLAS_TILE sets the kernel column tile (the true analog of the
     reference's -p gridDim.x cap — the CLI's -p sizes segments instead);

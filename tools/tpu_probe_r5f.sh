@@ -1,0 +1,51 @@
+#!/bin/bash
+# Round-5f: hardware validation of refold="autotune" (the operational
+# answer to the w16 dot bimodality the r5e map pinned as compile-time
+# nondeterminism).  Two separate w16 processes = two compile coin flips:
+# each run's calibration must either ship the fast-dot mode (~132-147
+# GB/s) or fall back to the stable sum (~102) — any reading >= ~95 GB/s
+# validates the floor; a fast reading additionally demonstrates the
+# upside.  One w8 headline-shape run sanity-checks that calibration
+# agrees with the static default (dot) where dot always wins.
+# Usage: tools/tpu_probe_r5f.sh [max_seconds]
+set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+. "$LIB"
+
+while pgrep -f "tpu_probe_r5[bcde]?[.]sh" >/dev/null 2>&1; do
+  echo "# waiting for earlier r5 watchers t=$((SECONDS - START))s" >&2
+  sleep 60
+  [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
+done
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; autotune validation set" >&2
+    for rep in a b; do
+      capture "w16_autotune_${rep}" 420 \
+        env RS_PALLAS_REFOLD=autotune \
+        python -m gpu_rscode_tpu.tools.w16_bench --trials 2 --mb 128
+    done
+    capture w8_autotune_k10 600 \
+      env RS_PALLAS_REFOLD=autotune \
+      python -m gpu_rscode_tpu.tools.expand_probe --trials 3 \
+      --expand shift_raw --acc int8
+    echo "# r5f autotune validation complete" >&2
+    exit 0
+  fi
+  sleep 120
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
