@@ -1,0 +1,45 @@
+#!/bin/bash
+# Round-5g: the bimodality map's last cell — tile 32768 x 2 processes at
+# w=16 mb=128 (both r5e attempts died rc=124 when the tunnel closed
+# ~08:40 UTC mid-map).  The map's verdict (compile-time nondeterminism,
+# not tile dependence) is already pinned by tiles 8192/16384; this only
+# completes the grid.  Runs after the r5f autotune validation.
+# Usage: tools/tpu_probe_r5g.sh [max_seconds]
+set -u
+LIB="$(cd "$(dirname "$0")" && pwd)/capture_lib.sh"
+cd /root/repo
+mkdir -p bench_captures
+MAX=${1:-36000}
+START=$SECONDS
+ATTEMPT=0
+. "$LIB"
+
+while pgrep -f "tpu_probe_r5[bcdef]?[.]sh" >/dev/null 2>&1; do
+  echo "# waiting for earlier r5 watchers t=$((SECONDS - START))s" >&2
+  sleep 60
+  [ $((SECONDS - START)) -ge "$MAX" ] && { echo "# deadline" >&2; exit 2; }
+done
+
+while [ $((SECONDS - START)) -lt "$MAX" ]; do
+  ATTEMPT=$((ATTEMPT + 1))
+  echo "# probe $ATTEMPT t=$((SECONDS - START))s" >&2
+  if timeout 75 python - <<'EOF' >/dev/null 2>&1
+import sys
+import jax
+sys.exit(0 if any(d.platform.lower() == "tpu" for d in jax.devices()) else 1)
+EOF
+  then
+    echo "# tunnel healthy; t32768 map cells" >&2
+    for rep in a b; do
+      capture "w16_bimodal_t32768_${rep}_retry" 420 \
+        env RS_PALLAS_EXPAND=shift_raw RS_PALLAS_REFOLD=dot \
+        RS_PALLAS_TILE=32768 \
+        python -m gpu_rscode_tpu.tools.w16_bench --trials 2 --mb 128
+    done
+    echo "# r5g map cells complete" >&2
+    exit 0
+  fi
+  sleep 120
+done
+echo "# deadline reached without healthy tunnel" >&2
+exit 2
