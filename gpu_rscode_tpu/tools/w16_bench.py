@@ -66,17 +66,20 @@ def main() -> int:
         except Exception as e:  # noqa: BLE001 — record, keep sweeping
             out[name] = f"fail:{type(e).__name__}"
         print(json.dumps({name: out[name]}), flush=True)
-    print(
-        json.dumps(
-            {
-                "metric": f"w16_gemm_bandwidth_k{K}_p{P}",
-                "unit": "GB/s",
-                "mb": args.mb,
-                "results": out,
-            }
-        ),
-        flush=True,
-    )
+    summary = {
+        "metric": f"w16_gemm_bandwidth_k{K}_p{P}",
+        "unit": "GB/s",
+        "mb": args.mb,
+        "results": out,
+    }
+    from ..ops import pallas_gemm as _pg
+
+    if _pg._AUTOTUNE_CACHE:
+        # Under RS_PALLAS_REFOLD=autotune, make the capture self-describing:
+        # which refold the per-process calibration shipped (the throughput
+        # alone only implies it — ~102 = sum, 132+ = fast dot at w=16).
+        summary["autotune"] = sorted(set(_pg._AUTOTUNE_CACHE.values()))
+    print(json.dumps(summary), flush=True)
     return 0
 
 
