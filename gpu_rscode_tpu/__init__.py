@@ -12,6 +12,9 @@ Public surface:
 - :mod:`gpu_rscode_tpu.cli` — the ``rs`` command (``python -m gpu_rscode_tpu``).
 - :mod:`gpu_rscode_tpu.ops` — GF(2^w) tables, GF-GEMM (XLA + Pallas), inversion.
 - :mod:`gpu_rscode_tpu.parallel` — mesh sharding + streaming pipelines.
+- :mod:`gpu_rscode_tpu.plan` — shape-bucketed execution plans: the bounded
+  AOT-executable cache (``plan.PLAN_CACHE``), buffer donation, and the
+  bucket ladder that keeps tail segments from recompiling (docs/PLAN.md).
 """
 
 __all__ = ["RSCodec"]

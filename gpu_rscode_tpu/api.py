@@ -26,7 +26,7 @@ import os
 import numpy as np
 
 from .codec import RSCodec
-from .parallel.pipeline import AsyncWindow, SegmentPrefetcher
+from .parallel.pipeline import AsyncWindow, DeviceStagingRing, SegmentPrefetcher
 from .utils.fileformat import (
     append_checksums,
     chunk_crc32,
@@ -110,6 +110,23 @@ def _segment_cols(chunk_size: int, native_num: int, segment_bytes: int) -> int:
     if cols < chunk_size:
         cols = max(128, cols - cols % 128)
     return min(cols, chunk_size)
+
+
+def _staging_ring(
+    prefetch, codec, seg_cols: int, sym: int, depth: int, out_rows=None
+):
+    """The H2D stage all three file loops (encode/decode/repair) share:
+    bucket-pad each prefetched segment and issue its async device_put
+    (``codec.stage_segment``), ``depth`` segments ahead of the consumer.
+    ``out_rows`` is the loop's dispatch output row count (lets the stage
+    skip the donation-recovery host copy when the output can't alias)."""
+    return DeviceStagingRing(
+        prefetch,
+        lambda tag, seg: codec.stage_segment(
+            seg, cap=seg_cols // sym, sym=sym, out_rows=out_rows
+        ),
+        depth=depth,
+    )
 
 
 def _segment_spans(chunk_size: int, seg_cols: int) -> list[tuple[int, int]]:
@@ -342,11 +359,17 @@ def encode_file(
                     (*tag, fut), parity_files, timer, crcs, k
                 ),
             ) as window:
-                for (off, cols), host_seg in prefetch:
-                    if sym > 1:  # reinterpret bytes as little-endian symbols
-                        host_seg = host_seg.view(np.uint16)
+                # 3-stage pipeline: the ring issues segment i+1's H2D (an
+                # async device_put of the bucket-padded segment, see
+                # plan.py) while segment i computes and segment i-1 drains
+                # its D2H + parity writes through the window.
+                staging = _staging_ring(
+                    prefetch, codec, seg_cols, sym, pipeline_depth,
+                    out_rows=codec.parity_block.shape[0],
+                )
+                for (off, cols), seg in staging:
                     with timer.phase("encode dispatch"):
-                        parity = codec.encode(host_seg)  # async
+                        parity = codec.encode(seg)  # async
                     window.push((off, cols), parity)
         finally:
             for fp in parity_files:
@@ -773,9 +796,11 @@ def decode_file(
                 with SegmentPrefetcher(
                     segments, stage, depth=pipeline_depth
                 ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
-                    for (off, cols), seg in prefetch:
-                        if sym > 1:
-                            seg = seg.view(np.uint16)
+                    staging = _staging_ring(
+                        prefetch, codec, seg_cols, sym, pipeline_depth,
+                        out_rows=dec_missing.shape[0],
+                    )
+                    for (off, cols), seg in staging:
                         with timer.phase("decode dispatch"):
                             rec = codec.decode(dec_missing, seg)  # async
                         window.push((off, cols), rec)
@@ -1483,9 +1508,11 @@ def _repair_streamed(
         with SegmentPrefetcher(
             _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
         ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
-            for (off, cols), seg in prefetch:
-                if sym > 1:
-                    seg = seg.view(np.uint16)
+            staging = _staging_ring(
+                prefetch, codec, seg_cols, sym, pipeline_depth,
+                out_rows=rebuild_mat.shape[0],
+            )
+            for (off, cols), seg in staging:
                 with timer.phase("repair dispatch"):
                     rebuilt = codec.decode(rebuild_mat, seg)  # async GEMM
                 window.push((off, cols), rebuilt)

@@ -144,14 +144,52 @@ class RSCodec:
     def encode(self, data):
         """(k, m) natives -> (p, m) parity.  Systematic: natives pass through
         unchanged, only parity is computed (the reference's encode kernel has
-        the same shape: (n-k) x k coefficient block, matrix.cu:767-776)."""
+        the same shape: (n-k) x k coefficient block, matrix.cu:767-776).
+        ``data`` may be a host array or a :class:`..plan.StagedSegment` the
+        pipeline pre-placed on the device (see :meth:`stage_segment`)."""
         return self._matmul(self.parity_block, data)
 
     def decode(self, decode_mat, chunks):
         """(k, k) recovery matrix x (k, m) surviving chunks -> (k, m) natives."""
         return self._matmul(decode_mat, chunks)
 
+    def stage_segment(self, seg, *, cap=None, sym: int = 1, out_rows=None):
+        """Stage one segment for the next encode/decode dispatch.
+
+        The H2D stage of the 3-stage pipeline (DeviceStagingRing): pads the
+        (k, cols) host segment to its plan bucket and issues the async
+        ``device_put``, returning a :class:`..plan.StagedSegment` whose
+        buffer the dispatch may DONATE.  ``sym`` > 1 reinterprets the raw
+        bytes as little-endian symbols first (the w=16 wide-symbol view).
+        ``out_rows`` is the coming dispatch's output row count when known
+        (parity rows for encode, recovery rows for decode/repair): a
+        dispatch whose output cannot alias the segment (out_rows != k)
+        never donates, so its stage skips the host recovery copy.
+        Where planning does not apply — layer disabled, host-only codec,
+        or a mesh (whose placement happens in ``_matmul`` via
+        ``put_sharded``) — the (viewed) host array is returned unchanged
+        and the dispatch behaves exactly as before.
+        """
+        if sym > 1:
+            seg = seg.view(np.uint16)
+        from . import plan as _plan
+
+        if self.mesh is not None or self.strategy == "cpu" or not _plan.enabled():
+            return seg
+        return _plan.stage_segment(
+            seg, cap,
+            retain_host=out_rows is None or out_rows == seg.shape[0],
+        )
+
     def _matmul(self, A, B):
+        from . import plan as _plan
+
+        seg = B if isinstance(B, _plan.StagedSegment) else None
+        staged = seg is not None
+        b_cols = seg.cols if staged else None
+        plan_cap = seg.cap if staged else None
+        if staged:
+            B = seg.array
         if self.strategy == "cpu":
             # Native host codec (the CPU-RS oracle role, cpu-rs.c) — no
             # device involved; useful as differential baseline and fallback.
@@ -159,15 +197,42 @@ class RSCodec:
 
             return native.gemm(np.asarray(A), np.asarray(B))
         if self.mesh is None:
+            # A StagedSegment is already bucket-padded: it must go through
+            # the plan layer (which knows to trim) even if RS_PLAN was
+            # flipped off between staging and dispatch.
+            use_plan = (_plan.enabled() or staged) and not isinstance(
+                B, jax.core.Tracer
+            )
             if self.strategy == "pallas":
                 # The fused kernel is a performance feature; a Mosaic
                 # compile/runtime failure must not fail the file operation.
                 # The first dispatch is materialised inside the guard (async
                 # dispatch would otherwise surface the error later, outside
                 # it); subsequent segments run the already-proven executable
-                # fully async.
+                # fully async.  That first dispatch also runs EAGERLY
+                # through the module hook — RS_PALLAS_REFOLD=autotune needs
+                # concrete arrays to calibrate, and tests inject failures
+                # there; once proven, the plan's AOT executable (with the
+                # calibrated refold baked in) takes over and may donate
+                # pipeline-staged buffers.
                 try:
-                    out = _gf_matmul_pallas_eager(A, B, self.w)
+                    if use_plan:
+                        # Donate only what can be re-staged: seg.host is
+                        # the recovery copy the demote path below needs.
+                        out = _plan.dispatch(
+                            A, B, w=self.w, strategy="pallas",
+                            cap=plan_cap, cols=b_cols,
+                            donate=staged and seg.host is not None
+                            and self._pallas_checked,
+                            eager_fn=(
+                                None if self._pallas_checked else
+                                lambda a, b: _gf_matmul_pallas_eager(
+                                    a, b, self.w
+                                )
+                            ),
+                        )
+                    else:
+                        out = _gf_matmul_pallas_eager(A, B, self.w)
                     if not self._pallas_checked:
                         jax.block_until_ready(out)
                         self._pallas_checked = True
@@ -185,6 +250,18 @@ class RSCodec:
                         stacklevel=3,
                     )
                     self.strategy = "bitplane"
+                    if staged and seg.host is not None and B.is_deleted():
+                        # The failed dispatch DONATED the staged device
+                        # buffer before raising; re-stage from the retained
+                        # host copy so the demoted recompute below reads
+                        # real data, not a deleted array.
+                        B = jax.device_put(seg.host)
+            if use_plan:
+                return _plan.dispatch(
+                    A, B, w=self.w, strategy=self.strategy,
+                    cap=plan_cap, cols=b_cols,
+                    donate=staged and seg.host is not None,
+                )
             return gf_matmul_jit(A, B, w=self.w, strategy=self.strategy)
         from .parallel.sharded import put_sharded, sharded_gf_matmul
 
@@ -193,6 +270,22 @@ class RSCodec:
         if pad:
             B = np.pad(np.asarray(B), ((0, 0), (0, pad)))
         Bd = put_sharded(B, self.mesh, self.stripe_sharded)
+
+        def _sharded(A_, B_, strategy):
+            # Mesh dispatches register in the same plan cache (keyed by the
+            # mesh fingerprint) so compile classes are counted uniformly;
+            # the executable itself stays pinned by the jitted collective.
+            run = lambda a, b: sharded_gf_matmul(  # noqa: E731
+                a, b, mesh=self.mesh, w=self.w, strategy=strategy,
+                stripe_sharded=self.stripe_sharded,
+            )
+            if not _plan.enabled():
+                return run(A_, B_)
+            return _plan.dispatch_mesh(
+                A_, B_, w=self.w, strategy=strategy, mesh=self.mesh,
+                stripe_sharded=self.stripe_sharded, fn=run,
+            )
+
         if self.strategy == "pallas":
             # Same guard discipline as the single-device path: every
             # pallas dispatch (including tail segments, which recompile
@@ -205,10 +298,7 @@ class RSCodec:
             # and a runtime wedge would surface at consumption, as on the
             # single-device path.
             try:
-                out = sharded_gf_matmul(
-                    np.asarray(A), Bd, mesh=self.mesh, w=self.w,
-                    strategy="pallas", stripe_sharded=self.stripe_sharded,
-                )
+                out = _sharded(np.asarray(A), Bd, "pallas")
                 if not self._pallas_checked:
                     jax.block_until_ready(out)
                     self._pallas_checked = True
@@ -224,14 +314,7 @@ class RSCodec:
                     stacklevel=3,
                 )
                 self.strategy = "bitplane"
-        out = sharded_gf_matmul(
-            np.asarray(A),
-            Bd,
-            mesh=self.mesh,
-            w=self.w,
-            strategy=self.strategy,
-            stripe_sharded=self.stripe_sharded,
-        )
+        out = _sharded(np.asarray(A), Bd, self.strategy)
         return out[:, :m] if pad else out
 
     # ----- decode-matrix construction (host) --------------------------------

@@ -89,6 +89,7 @@ bf16 MXU pass rounds to 256) whose multi-pass cost sinks it to 2.4 GB/s
 from __future__ import annotations
 
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -464,7 +465,34 @@ def _pallas_matmul(
 # WITHIN one process — so one timed calibration per compiled shape class
 # is sound for the process lifetime: XLA's jit cache keeps that exact
 # compilation alive, and a new shape class gets its own calibration.
+# Writes go through _AUTOTUNE_LOCK (concurrent codec threads can race the
+# same cold key; the worst pre-lock case was a benign duplicate
+# calibration — the lock also makes the read accessors consistent).
+# External readers use autotune_decisions(), never the dict itself.
 _AUTOTUNE_CACHE: dict = {}
+_AUTOTUNE_LOCK = threading.Lock()
+
+
+def autotune_decisions() -> dict:
+    """Snapshot of the refold='autotune' calibration results, keyed by
+    dispatch configuration (shapes, dtypes, w, tile, acc_dtype, expand,
+    interpret) with values "sum"/"dot".  The supported read surface for
+    tools and benches (tools/w16_bench.py) — the backing dict is private
+    and lock-guarded."""
+    with _AUTOTUNE_LOCK:
+        return dict(_AUTOTUNE_CACHE)
+
+
+def clear_autotune_cache() -> None:
+    """Drop every calibration decision.  Pair with ``jax.clear_caches()``:
+    a decision is only sound while the executable it timed stays alive in
+    XLA's jit cache — after an eviction the next compile re-flips the w16
+    fast/slow coin while a stale pinned "dot" would silently re-expose the
+    slow mode (ADVICE r5 finding 2).  Also invoked by the execution-plan
+    cache's clear() (plan.PLAN_CACHE), which pins refold choices into AOT
+    executables the same way."""
+    with _AUTOTUNE_LOCK:
+        _AUTOTUNE_CACHE.clear()
 
 # Require a real win before preferring the variable mode: ties and noise
 # go to the stable "sum".  The measured gap is wide on both sides (dot
@@ -506,10 +534,11 @@ def _autotune_refold(A, B, w, tile, acc_dtype, interpret, expand) -> str:
     floor is the static default's throughput minus one calibration.
     """
     key = (
-        A.shape, str(A.dtype), B.shape, str(B.dtype), w, tile,
+        tuple(A.shape), str(A.dtype), tuple(B.shape), str(B.dtype), w, tile,
         str(acc_dtype), expand, interpret,
     )
-    hit = _AUTOTUNE_CACHE.get(key)
+    with _AUTOTUNE_LOCK:
+        hit = _AUTOTUNE_CACHE.get(key)
     if hit is not None:
         return hit
     times = {}
@@ -521,18 +550,29 @@ def _autotune_refold(A, B, w, tile, acc_dtype, interpret, expand) -> str:
                     fold=True, refold=cand,
                 )
             )
-        except Exception:
-            # A refold variant that fails to lower simply loses the
-            # race; if BOTH fail the caller's normal dispatch raises
-            # through the existing Mosaic-failure fallback.
+        except Exception as e:
+            # Narrow handling (the codec's stated philosophy, codec.py:31):
+            # only a backend/Mosaic failure means "this variant can't run
+            # here" and loses the race; a ValueError/TypeError is a
+            # programming bug and must propagate — silently caching 'sum'
+            # over it would mask a dot-specific code bug with no signal
+            # (ADVICE r5 finding 1).  If BOTH variants fail with backend
+            # errors the caller's normal dispatch raises through the
+            # existing Mosaic-failure fallback.
+            from .. import codec as _codec
+
+            if not isinstance(e, _codec._pallas_failure_types()):
+                raise
             times[cand] = float("inf")
     choice = (
         "dot"
         if times["dot"] < _AUTOTUNE_MARGIN * times["sum"]
         else "sum"
     )
-    _AUTOTUNE_CACHE[key] = choice
-    return choice
+    with _AUTOTUNE_LOCK:
+        # First writer wins: a thread that raced the same cold key already
+        # proved its (identical) choice; keep the cache write-once per key.
+        return _AUTOTUNE_CACHE.setdefault(key, choice)
 
 
 def _default_refold(w: int) -> str:
@@ -542,6 +582,89 @@ def _default_refold(w: int) -> str:
     definition shared by the env-fallback, pre-parity and tracer-guard
     resolution paths."""
     return "dot" if w == 8 else "sum"
+
+
+def static_refold(w: int) -> str | None:
+    """RS_PALLAS_REFOLD resolved to a static "sum"/"dot" with NO warning:
+    "autotune" (and unknown values) map to the per-width default.  For
+    dispatch sites that always run under a jit/shard_map trace — the mesh
+    cols-sharded path — where calibration is impossible by construction
+    and the tracer-guard's 'cannot calibrate' warning would fire on every
+    trace, false-alarming the verify skill's warning check on perfectly
+    healthy mesh runs (ADVICE r5 finding 3).  Returns ``None`` when the
+    expand env resolves to pack2 (its fixed packed-refold pipeline REJECTS
+    an explicit refold; the pack2 path returns before any refold env read,
+    so ``None`` is both required and warning-safe there).  An UNKNOWN env
+    value keeps the module's warn-and-fall-back hygiene — only the
+    documented "autotune"→default mapping is silent."""
+    import os
+
+    if os.environ.get("RS_PALLAS_EXPAND") == "pack2" and w == 8:
+        return None
+    env = os.environ.get("RS_PALLAS_REFOLD")
+    if env in ("sum", "dot"):
+        return env
+    if env and env != "autotune":
+        return _env_fallback(
+            f"RS_PALLAS_REFOLD={env!r} is unknown", _default_refold(w)
+        )
+    return _default_refold(w)
+
+
+def plan_refold_resolution(w: int) -> str | None:
+    """The refold an AOT execution plan (plan.ExecutionPlan) should bake:
+    every non-calibrating case delegates to :func:`static_refold` (env
+    pass-through, typo fallback, pack2's ``None`` — the mesh path and AOT
+    plans must never bake DIFFERENT resolutions of the same env), while
+    ``"autotune"`` is returned AS the string ``"autotune"`` so the plan
+    calibrates against its OWN executables via
+    :func:`calibrate_aot_refold` — the eager path's cached decision
+    described a different compile, and dot speed at w=16 is per-compile
+    bimodal (see the module docstring), so inheriting it would silently
+    re-expose the slow mode the calibration exists to avoid."""
+    import os
+
+    # Derive, don't duplicate, static_refold's pack2 gate: a None static
+    # resolution means pack2 applies and refold must stay unset — only a
+    # refold-bearing pipeline may escalate to per-plan calibration.
+    s = static_refold(w)
+    if s is not None and os.environ.get("RS_PALLAS_REFOLD") == "autotune":
+        return "autotune"
+    return s
+
+
+def calibrate_aot_refold(A, B, w, compile_variant):
+    """Resolve ``refold="autotune"`` for one AOT plan build by timing the
+    two candidates AS COMPILED BY THE CALLER on the actual operands —
+    ``compile_variant(refold)`` must return the plan's own compiled
+    executable for that refold.  Returns ``(choice, executable)`` so the
+    winner's compile is not repeated.  The eager decision cache is
+    deliberately NOT consulted or written: each decision is only sound
+    for the executable it timed."""
+    from .. import codec as _codec
+
+    times, exes = {}, {}
+    for cand in ("sum", "dot"):
+        try:
+            exe = compile_variant(cand)
+            times[cand] = _time_refold(lambda: exe(A, B))
+            exes[cand] = exe
+        except Exception as e:
+            # Same narrow handling as _autotune_refold: backend/Mosaic
+            # failures lose the race, programming bugs propagate.
+            if not isinstance(e, _codec._pallas_failure_types()):
+                raise
+            times[cand] = float("inf")
+    choice = (
+        "dot"
+        if times["dot"] < _AUTOTUNE_MARGIN * times["sum"]
+        else "sum"
+    )
+    if choice not in exes:
+        # Both candidates failed to compile: surface the failure through
+        # the caller's normal dispatch guard by compiling the default.
+        return choice, compile_variant(choice)
+    return choice, exes[choice]
 
 
 def _default_expand(w: int, acc_dtype) -> str:

@@ -59,6 +59,58 @@ class AsyncWindow(Generic[T]):
         return False
 
 
+class DeviceStagingRing:
+    """Double-buffered device staging: the H2D transfer of segment i+1 is
+    issued while segment i computes and segment i-1 drains.
+
+    Completes the 3-stage H2D -> compute -> D2H pipeline of the reference's
+    stream loop (encode.cu:165-218) on the device side: the
+    :class:`SegmentPrefetcher` overlaps *read IO* with everything, the
+    :class:`AsyncWindow` overlaps *D2H + write IO* with compute — but the
+    H2D placement itself used to happen inside the dispatch call, so the
+    transfer of segment i+1 only started after segment i's dispatch
+    returned.  This ring pulls ``depth`` segments ahead of the consumer and
+    calls ``stage(tag, host_seg)`` on each (typically
+    ``codec.stage_segment`` — an async ``jax.device_put`` of the
+    bucket-padded segment), so the DMA is in flight before the consumer
+    asks for the data.
+
+    ``source`` yields ``(tag, host_segment)`` (a SegmentPrefetcher is one);
+    iteration yields ``(tag, staged)`` in source order.  ``stage`` runs on
+    the consumer thread (device_put returns immediately; nothing here
+    blocks), and its exceptions propagate at the consuming ``__next__``.
+    ``depth=2`` is the double-buffer: one segment staged ahead of the one
+    being handed out.
+    """
+
+    def __init__(self, source, stage, depth: int = 2):
+        self._source = iter(source)
+        self._stage = stage
+        self._depth = max(1, depth)
+        self._staged: list = []
+        self._exhausted = False
+
+    def _fill(self) -> None:
+        while not self._exhausted and len(self._staged) < self._depth:
+            try:
+                tag, host = next(self._source)
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._staged.append((tag, self._stage(tag, host)))
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        self._fill()
+        if not self._staged:
+            raise StopIteration
+        tag, staged = self._staged.pop(0)
+        self._fill()  # issue the next H2D before handing this segment out
+        return tag, staged
+
+
 class SegmentPrefetcher:
     """Stage segments on a worker thread into a bounded queue.
 

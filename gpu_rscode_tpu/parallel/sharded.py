@@ -58,9 +58,32 @@ def sharded_gf_matmul(A, B, *, mesh, w=8, strategy="bitplane", stripe_sharded=Fa
     out_dtype = jnp.uint8 if gf.dtype == np.uint8 else jnp.uint16
 
     if not stripe_sharded:
+        if strategy == "pallas":
+            # This dispatch always runs under the shard_map/jit trace,
+            # where refold='autotune' cannot calibrate (the operands are
+            # tracers).  Resolve the env knob to a static value HERE —
+            # env "sum"/"dot" pass through, "autotune" takes the per-width
+            # static default, pack2 expand yields None (its fixed pipeline
+            # rejects an explicit refold) — instead of letting the
+            # kernel's tracer guard warn 'cannot calibrate under a jit
+            # trace' on every mesh trace: that warning is a real
+            # regression signal on the eager path and must not cry wolf
+            # here (ADVICE r5 finding 3).
+            from ..ops.pallas_gemm import gf_matmul_pallas, static_refold
 
-        def body(a_loc, b_loc):
-            return _gemm.gf_matmul(a_loc, b_loc, w=w, strategy=strategy).astype(out_dtype)
+            refold = static_refold(w)
+
+            def body(a_loc, b_loc):
+                return gf_matmul_pallas(
+                    a_loc, b_loc, w=w, refold=refold
+                ).astype(out_dtype)
+
+        else:
+
+            def body(a_loc, b_loc):
+                return _gemm.gf_matmul(
+                    a_loc, b_loc, w=w, strategy=strategy
+                ).astype(out_dtype)
 
         return shard_map(
             body,

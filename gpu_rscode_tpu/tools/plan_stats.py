@@ -1,0 +1,94 @@
+"""Execution-plan cache inspector — the dispatch-overhead dashboard.
+
+Runs a synthetic multi-tail encode workload (several files whose chunk
+sizes produce different tail-segment widths — exactly the shapes that used
+to cost one XLA trace+compile EACH) and dumps the plan cache: hit/miss
+counters, the executables it holds, and the bucket-ladder bound the
+workload should respect.  The final stdout line is machine-readable JSON
+(the same one-line contract as the benches); ``--no-workload`` skips the
+synthetic encodes and dumps whatever the current process accumulated.
+
+Usage: python -m gpu_rscode_tpu.tools.plan_stats \
+           [--k 4] [--p 2] [--seg-kb 4] [--tails 520 652 776 1000] [--w 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+
+import numpy as np
+
+
+def _ladder_bound(seg_cols: int) -> int:
+    """Maximum distinct buckets a segment loop can produce under one cap —
+    computed FROM plan.bucket_cols itself (correct by construction under
+    RS_PLAN_MIN_BUCKET and any future ladder change, unlike a closed-form
+    duplicate of the ladder math)."""
+    from .. import plan
+
+    return len({plan.bucket_cols(m, seg_cols) for m in range(1, seg_cols + 1)})
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m gpu_rscode_tpu.tools.plan_stats"
+    )
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--p", type=int, default=2)
+    ap.add_argument("--seg-kb", type=int, default=4,
+                    help="segment_bytes in KiB (small => many segments)")
+    ap.add_argument("--tails", type=int, nargs="+",
+                    default=[520, 652, 776, 1000],
+                    help="tail widths (cols) to synthesize, one file each")
+    ap.add_argument("--w", type=int, default=8, choices=(8, 16))
+    ap.add_argument("--no-workload", action="store_true",
+                    help="dump current process stats without encoding")
+    args = ap.parse_args(argv)
+
+    from .. import api, plan
+
+    seg_bytes = args.seg_kb * 1024
+    sym = args.w // 8
+    # The SAME width the live encode derives (api._segment_cols applies
+    # 128-lane down-alignment) — the chunks synthesized below are larger
+    # than one segment, so the alignment branch always applies.
+    seg_cols = api._segment_cols(1 << 62, args.k, seg_bytes) // sym
+    if not args.no_workload:
+        plan.PLAN_CACHE.clear()
+        rng = np.random.default_rng(0)
+        with tempfile.TemporaryDirectory() as d:
+            for tail in args.tails:
+                chunk = (2 * seg_cols + tail) * sym
+                path = os.path.join(d, f"t{tail}.bin")
+                open(path, "wb").write(
+                    rng.integers(
+                        0, 256, size=args.k * chunk, dtype=np.uint8
+                    ).tobytes()
+                )
+                api.encode_file(
+                    path, args.k, args.p, segment_bytes=seg_bytes, w=args.w
+                )
+
+    from ..ops.pallas_gemm import autotune_decisions
+
+    stats = plan.PLAN_CACHE.stats()
+    encode_execs = [
+        pl for pl in stats["plans"] if pl["a_shape"] == [args.p, args.k]
+    ]
+    out = {
+        "metric": "plan_cache_stats",
+        "stats": stats,
+        "encode_executables": len(encode_execs),
+        "ladder_bound": _ladder_bound(seg_cols),
+        "mesh_registered": plan.MESH_PLAN_CACHE.stats()["executables"],
+        "autotune_decisions": len(autotune_decisions()),
+    }
+    print(json.dumps(out), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

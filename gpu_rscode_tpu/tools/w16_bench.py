@@ -72,13 +72,14 @@ def main() -> int:
         "mb": args.mb,
         "results": out,
     }
-    from ..ops import pallas_gemm as _pg
+    from ..ops.pallas_gemm import autotune_decisions
 
-    if _pg._AUTOTUNE_CACHE:
+    decisions = autotune_decisions()
+    if decisions:
         # Under RS_PALLAS_REFOLD=autotune, make the capture self-describing:
         # which refold the per-process calibration shipped (the throughput
         # alone only implies it — ~102 = sum, 132+ = fast dot at w=16).
-        summary["autotune"] = sorted(set(_pg._AUTOTUNE_CACHE.values()))
+        summary["autotune"] = sorted(set(decisions.values()))
     print(json.dumps(summary), flush=True)
     return 0
 

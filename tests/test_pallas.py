@@ -350,13 +350,26 @@ def test_refold_autotune_decision(monkeypatch):
     )
     assert seen[-1]["refold"] == "sum" and len(calls) == 2
 
-    # A dot lowering failure just loses the race (the real timer's warm-up
-    # call raises before timing).
-    calls = _fake_timer(monkeypatch, [1.0, RuntimeError("mosaic refused")])
+    # A dot lowering failure — a BACKEND failure type — just loses the
+    # race (the real timer's warm-up call raises before timing).
+    import jax
+
+    calls = _fake_timer(
+        monkeypatch, [1.0, jax.errors.JaxRuntimeError("mosaic refused")]
+    )
     np.testing.assert_array_equal(
         np.asarray(gf_matmul_pallas(A, B, w=16, refold="autotune")), want
     )
     assert seen[-1]["refold"] == "sum" and len(calls) == 2
+
+    # A NON-backend exception is a programming bug and must propagate, not
+    # be silently cached as a 'sum' win with no signal (ADVICE r5 finding
+    # 1 — the calibration keeps the codec's broad-catch-narrow-handling
+    # philosophy, codec.py:31).
+    calls = _fake_timer(monkeypatch, [1.0, ValueError("shape bug")])
+    with pytest.raises(ValueError, match="shape bug"):
+        gf_matmul_pallas(A, B, w=16, refold="autotune")
+    assert not pg.autotune_decisions()  # nothing cached over the bug
 
 
 def test_refold_autotune_env_and_preparity(monkeypatch):

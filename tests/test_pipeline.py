@@ -9,7 +9,11 @@ import pytest
 
 from gpu_rscode_tpu import api
 from gpu_rscode_tpu.parallel.mesh import make_mesh
-from gpu_rscode_tpu.parallel.pipeline import AsyncWindow, SegmentPrefetcher
+from gpu_rscode_tpu.parallel.pipeline import (
+    AsyncWindow,
+    DeviceStagingRing,
+    SegmentPrefetcher,
+)
 from gpu_rscode_tpu.tools.make_conf import make_conf
 
 
@@ -108,6 +112,38 @@ def test_prefetcher_early_exit_stops_worker():
             raise RuntimeError("consumer died")
     assert not pf._thread.is_alive()
     assert len(produced) < 100  # cancelled long before the end
+
+
+def test_staging_ring_orders_and_stages_ahead():
+    """The double-buffered ring hands segments out in source order while
+    keeping ``depth`` segments staged ahead: segment i+1's H2D is issued
+    before segment i is consumed (the 3-stage H2D || compute || D2H
+    overlap of the reference's stream loop)."""
+    staged = []
+    src = [((i, 1), f"h{i}") for i in range(5)]
+    ring = DeviceStagingRing(
+        src, lambda tag, h: staged.append(tag[0]) or f"d{h}", depth=2
+    )
+    tag, dev = next(iter(ring))
+    assert tag == (0, 1) and dev == "dh0"
+    # depth=2 staged ahead plus the one just handed out
+    assert staged == [0, 1, 2]
+    assert list(ring) == [((i, 1), f"dh{i}") for i in range(1, 5)]
+    assert staged == [0, 1, 2, 3, 4]  # each staged exactly once, in order
+
+
+def test_staging_ring_propagates_stage_error():
+    """A failing stage (H2D) surfaces at the consuming __next__, like the
+    prefetcher's produce errors."""
+
+    def stage(tag, h):
+        if tag[0] == 2:
+            raise OSError("dma gone")
+        return h
+
+    ring = DeviceStagingRing([((i, 1), i) for i in range(5)], stage, depth=2)
+    with pytest.raises(OSError, match="dma gone"):
+        list(ring)
 
 
 def test_encode_failure_atomic(tmp_path, monkeypatch):
