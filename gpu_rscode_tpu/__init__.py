@@ -15,6 +15,10 @@ Public surface:
 - :mod:`gpu_rscode_tpu.plan` — shape-bucketed execution plans: the bounded
   AOT-executable cache (``plan.PLAN_CACHE``), buffer donation, and the
   bucket ladder that keeps tail segments from recompiling (docs/PLAN.md).
+- :mod:`gpu_rscode_tpu.obs` — unified observability: the ``RS_METRICS``
+  registry (counters/gauges/histograms, ``rs stats`` / ``--metrics-json``)
+  and the ``RS_TRACE`` span tracer with Chrome-trace/Perfetto export
+  (docs/OBSERVABILITY.md).
 """
 
 __all__ = ["RSCodec"]

@@ -21,11 +21,13 @@ and ``decode_file`` (decode.cu:235-434), redesigned for a TPU host runtime:
 
 from __future__ import annotations
 
+import functools
 import os
 
 import numpy as np
 
 from .codec import RSCodec
+from .obs import metrics as _obs_metrics, tracing as _obs_tracing
 from .parallel.pipeline import AsyncWindow, DeviceStagingRing, SegmentPrefetcher
 from .utils.fileformat import (
     append_checksums,
@@ -71,6 +73,33 @@ class ChunkIntegrityError(ValueError):
 # Default segment sizing: bound host+device working set to ~64 MiB of natives
 # per in-flight segment (k rows x seg_cols bytes).
 DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
+
+
+def _observed_file_op(op: str):
+    """Wrap a file-level entry point with the unified observability surface
+    (docs/OBSERVABILITY.md): every wrapped function accepts an extra
+    keyword-only ``trace_path=`` argument that — like the ``RS_TRACE`` env
+    var — activates a span-tracing session exported as Chrome-trace /
+    Perfetto JSON on completion, records a top-level span, and counts the
+    operation in ``rs_file_ops_total`` (RS_METRICS).  Sessions are
+    reentrant, so nested entry points (auto_decode -> decode, fleet ->
+    repair) record into ONE trace owned by the outermost call."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            trace_path = kwargs.pop("trace_path", None)
+            with _obs_tracing.session(trace_path):
+                with _obs_tracing.span(op, lane="op"):
+                    out = fn(*args, **kwargs)
+            _obs_metrics.counter(
+                "rs_file_ops_total", "file-level operations completed"
+            ).labels(op=op).inc()
+            return out
+
+        return wrapper
+
+    return deco
 
 # Fleet repair routes batched survivor inversions to the device on TPU
 # backends per the measured k x batch grid
@@ -126,6 +155,14 @@ def _staging_ring(
             seg, cap=seg_cols // sym, sym=sym, out_rows=out_rows
         ),
         depth=depth,
+    )
+
+
+def _dispatch_span(op: str, off: int, cols: int):
+    """Per-segment dispatch span (one per dispatched segment, with its
+    column range in args — the trace's unit of accountability)."""
+    return _obs_tracing.span(
+        "dispatch", lane="dispatch", op=op, off=int(off), cols=int(cols)
     )
 
 
@@ -255,6 +292,7 @@ def _write_native_chunks(
                 crcs[i] = crc
 
 
+@_observed_file_op("encode")
 def encode_file(
     file_name: str,
     native_num: int,
@@ -282,6 +320,11 @@ def encode_file(
     extension: chunks hold little-endian uint16 symbols, recorded in
     .METADATA as ``# gfwidth 16``; supports up to 65536 total chunks where
     GF(2^8) caps out at 256).
+
+    Observability: like every file-level entry point, accepts a
+    keyword-only ``trace_path=`` (or the ``RS_TRACE`` env var) that writes
+    a per-segment Chrome-trace/Perfetto JSON timeline, and feeds the
+    ``RS_METRICS`` registry — see docs/OBSERVABILITY.md.
     """
     timer = timer or PhaseTimer(enabled=False)
     if w not in (8, 16):
@@ -368,7 +411,9 @@ def encode_file(
                     out_rows=codec.parity_block.shape[0],
                 )
                 for (off, cols), seg in staging:
-                    with timer.phase("encode dispatch"):
+                    with timer.phase("encode dispatch"), _dispatch_span(
+                        "encode", off, cols
+                    ):
                         parity = codec.encode(seg)  # async
                     window.push((off, cols), parity)
         finally:
@@ -566,7 +611,9 @@ def _encode_file_multiprocess(
                 _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
             ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
                 for (off, cols), local_seg in prefetch:
-                    with timer.phase("encode dispatch"):
+                    with timer.phase("encode dispatch"), _dispatch_span(
+                        "encode", off, cols
+                    ):
                         Bd = put_sharded(local_seg, mesh, stripe_sharded)
                         parity = sharded_gf_matmul(
                             np.asarray(codec.parity_block), Bd,
@@ -616,6 +663,7 @@ def _encode_file_multiprocess(
     return written
 
 
+@_observed_file_op("decode")
 def decode_file(
     in_file: str,
     conf_file: str,
@@ -801,7 +849,9 @@ def decode_file(
                         out_rows=dec_missing.shape[0],
                     )
                     for (off, cols), seg in staging:
-                        with timer.phase("decode dispatch"):
+                        with timer.phase("decode dispatch"), _dispatch_span(
+                            "decode", off, cols
+                        ):
                             rec = codec.decode(dec_missing, seg)  # async
                         window.push((off, cols), rec)
             else:
@@ -1161,7 +1211,9 @@ def _decode_file_multiprocess(
                     depth=pipeline_depth,
                 ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
                     for (off, cols), local_seg in prefetch:
-                        with timer.phase("decode dispatch"):
+                        with timer.phase("decode dispatch"), _dispatch_span(
+                            "decode", off, cols
+                        ):
                             Bd = put_sharded(local_seg, mesh, stripe_sharded)
                             rec = sharded_gf_matmul(
                                 np.asarray(dec_missing), Bd,
@@ -1297,6 +1349,7 @@ def _select_decodable_subset(scan: _ChunkScan):
     )
 
 
+@_observed_file_op("auto_decode")
 def auto_decode_file(
     in_file: str,
     output: str | None = None,
@@ -1371,6 +1424,7 @@ def auto_decode_file(
     return decode_file(in_file, conf_path, output, **decode_kwargs)
 
 
+@_observed_file_op("repair")
 def repair_file(
     in_file: str,
     *,
@@ -1513,7 +1567,9 @@ def _repair_streamed(
                 out_rows=rebuild_mat.shape[0],
             )
             for (off, cols), seg in staging:
-                with timer.phase("repair dispatch"):
+                with timer.phase("repair dispatch"), _dispatch_span(
+                    "repair", off, cols
+                ):
                     rebuilt = codec.decode(rebuild_mat, seg)  # async GEMM
                 window.push((off, cols), rebuilt)
         for t in targets:
@@ -1690,7 +1746,9 @@ def _repair_file_multiprocess(
                 _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
             ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
                 for (off, cols), local_seg in prefetch:
-                    with timer.phase("repair dispatch"):
+                    with timer.phase("repair dispatch"), _dispatch_span(
+                        "repair", off, cols
+                    ):
                         Bd = put_sharded(local_seg, mesh, stripe_sharded)
                         rebuilt = sharded_gf_matmul(
                             np.asarray(rebuild_mat), Bd,
@@ -1723,6 +1781,7 @@ def _repair_file_multiprocess(
     return targets
 
 
+@_observed_file_op("repair_fleet")
 def repair_fleet(
     files,
     *,
@@ -1871,6 +1930,7 @@ def repair_fleet(
     return results
 
 
+@_observed_file_op("scan")
 def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> dict:
     """Read-only archive health report (the scrubbing half of repair).
 

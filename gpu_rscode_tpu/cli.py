@@ -58,7 +58,61 @@ Extensions: [--generator vandermonde|cauchy]
             after the flags repair a whole fleet: all survivor-matrix
             inversions run in one batched device dispatch)
             [--scrub]  (with -i: read-only health report as one JSON line)
+Observability (docs/OBSERVABILITY.md):
+            [--metrics-json PATH] (encode/decode/repair: collect the
+            RS_METRICS registry during the run — enabled automatically —
+            and dump the unified snapshot, plan cache included, as JSON)
+            [--trace PATH] (encode/decode/repair: write a per-segment
+            Chrome-trace/Perfetto timeline; equivalent to RS_TRACE=PATH)
+Subcommand:  rs stats [--text] [--workload]
+            (dump the unified observability snapshot of this process;
+            --text = Prometheus exposition, --workload = run a synthetic
+            multi-tail encode first)
 """
+
+
+def _stats_main(argv: list[str]) -> int:
+    """The ``rs stats`` subcommand: dump the unified observability
+    snapshot (metrics registry + plan-cache stats + autotune decisions)."""
+    import argparse
+    import json
+
+    from .obs import metrics as obs_metrics
+
+    ap = argparse.ArgumentParser(
+        prog="rs stats",
+        description="Dump the unified observability snapshot "
+        "(RS_METRICS registry + plan cache + autotune decisions).",
+    )
+    ap.add_argument(
+        "--text", action="store_true",
+        help="Prometheus text exposition instead of one-line JSON",
+    )
+    ap.add_argument(
+        "--workload", action="store_true",
+        help="run the synthetic multi-tail encode workload first "
+        "(a fresh process otherwise has little to report)",
+    )
+    # No --reset flag: a CLI invocation exits right after dumping, so a
+    # registry clear could never be observed; in-process embedders use
+    # obs.metrics.REGISTRY.reset() directly.
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        # Same int-return contract as every other usage-error path (_fail
+        # returns 2); argparse must not raise through a programmatic
+        # main() caller.
+        return int(e.code or 0)
+    if args.workload:
+        obs_metrics.force_enable()
+        from .tools.plan_stats import run_workload
+
+        run_workload()
+    if args.text:
+        print(obs_metrics.REGISTRY.render_text(), end="")
+    else:
+        print(json.dumps(obs_metrics.unified_snapshot()))
+    return 0
 
 
 def _fail(msg: str) -> "int":
@@ -69,6 +123,8 @@ def _fail(msg: str) -> "int":
 
 def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] == "stats":
+        return _stats_main(argv[1:])
     try:
         # gnu_getopt: flags may follow the fleet-repair positional archives
         # (the reference surface has no positionals, so ordering semantics
@@ -90,6 +146,8 @@ def main(argv: list[str] | None = None) -> int:
                 "auto",
                 "repair",
                 "scrub",
+                "metrics-json=",
+                "trace=",
             ],
         )
     except getopt.GetoptError as e:
@@ -114,6 +172,8 @@ def main(argv: list[str] | None = None) -> int:
     auto = False
     repair = False
     scrub = False
+    metrics_json = None
+    trace_path = None
 
     repair_requested = any(fl in ("--repair", "--scrub") for fl, _ in opts)
     for flag, val in opts:
@@ -170,6 +230,10 @@ def main(argv: list[str] | None = None) -> int:
             repair = True
         elif f == "--scrub":
             scrub = True
+        elif f == "--metrics-json":
+            metrics_json = val
+        elif f == "--trace":
+            trace_path = val
 
     if repair and scrub:
         return _fail("rs: --repair and --scrub conflict")
@@ -206,13 +270,34 @@ def main(argv: list[str] | None = None) -> int:
         return _fail("rs: --auto is decode-only")
     if auto and conf_file:
         return _fail("rs: -c and --auto conflict; pick one survivor source")
+    if op == "scrub" and (metrics_json or trace_path):
+        return _fail(
+            "rs: --metrics-json/--trace apply to encode/decode/repair "
+            "(scrub is a host-only CRC pass)"
+        )
+    if stripe > 1 and not n_devices:
+        return _fail("rs: --stripe requires --devices")
+
+    if metrics_json:
+        # Fail fast on an unwritable snapshot path — AFTER every pure
+        # usage validation above (no probe file on a usage error), BEFORE
+        # the slow jax import / mesh init below and long before the run
+        # whose metrics the user would otherwise lose.  A newly created
+        # (empty) probe file gets a "{}" placeholder so every later exit
+        # — even an uncaught mesh-init crash before the try/finally —
+        # leaves valid JSON, never a zero-byte file; dump_metrics()
+        # overwrites it with the real snapshot.
+        try:
+            with open(metrics_json, "a") as fp:
+                if fp.tell() == 0:
+                    fp.write("{}\n")
+        except OSError as e:
+            return _fail(f"rs: cannot write --metrics-json path: {e}")
 
     # Import lazily: jax init is slow and -h must be instant.
     from . import api
 
     kwargs = dict(strategy=strategy, pipeline_depth=max(1, pipeline_depth))
-    if stripe > 1 and not n_devices:
-        return _fail("rs: --stripe requires --devices")
     if n_devices:
         from .parallel import distributed
         from .parallel.mesh import make_mesh
@@ -229,6 +314,32 @@ def main(argv: list[str] | None = None) -> int:
         # -p caps the per-dispatch column extent, the closest analog of the
         # reference's gridDim.x cap (encode.cu:348-355).
         kwargs["segment_bytes"] = max(1, tile_hint) * 128 * 1024
+
+    if metrics_json:
+        # Collection must be on DURING the run; --metrics-json implies it
+        # (the in-process equivalent of exporting RS_METRICS=1).
+        from .obs import metrics as obs_metrics
+
+        obs_metrics.force_enable()
+    if trace_path:
+        kwargs["trace_path"] = trace_path  # == RS_TRACE=PATH for this op
+
+    def dump_metrics() -> None:
+        # Called on success AND failure: the snapshot is most valuable
+        # when a long run died near the end, and a zero-byte probe file
+        # left behind would crash downstream json.load's.
+        if not metrics_json:
+            return
+        import json
+
+        from .obs import metrics as obs_metrics
+
+        try:
+            with open(metrics_json, "w") as fp:
+                json.dump(obs_metrics.unified_snapshot(), fp)
+                fp.write("\n")
+        except OSError as e:  # writability probed up front; disk-full etc.
+            print(f"rs: metrics snapshot write failed: {e}", file=sys.stderr)
 
     timer = PhaseTimer(enabled=True)
     ctx = None
@@ -312,6 +423,12 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         if ctx is not None:
             ctx.__exit__(None, None, None)
+        # In the finally: the snapshot must land on EVERY exit from the
+        # run — success, handled error, unhandled exception (device
+        # runtime errors, KeyboardInterrupt on a long encode) or a
+        # post-probe validation _fail — never leaving the zero-byte
+        # writability-probe file behind.
+        dump_metrics()
 
     if not quiet:
         print(f"== {op} {in_file} ==")

@@ -16,6 +16,7 @@ import jax
 import numpy as np
 
 from .models.vandermonde import generator_matrix
+from .obs import metrics as _obs_metrics
 from .ops.gemm import Strategy, gf_matmul_jit
 from .ops.gf import get_field
 from .ops.inverse import invert_matrix
@@ -141,16 +142,29 @@ class RSCodec:
 
     # ----- stripe ops (device) ----------------------------------------------
 
+    def _count_segment(self, op: str, data) -> None:
+        """Registry accounting for one stripe dispatch (no-op unless
+        RS_METRICS).  Skipped under a caller's jit trace — a Python-level
+        increment there would count TRACES, not dispatches."""
+        if isinstance(data, jax.core.Tracer):
+            return
+        _obs_metrics.counter(
+            "segments_dispatched",
+            "stripe GEMM dispatches by operation and strategy",
+        ).labels(op=op, strategy=self.strategy, w=self.w).inc()
+
     def encode(self, data):
         """(k, m) natives -> (p, m) parity.  Systematic: natives pass through
         unchanged, only parity is computed (the reference's encode kernel has
         the same shape: (n-k) x k coefficient block, matrix.cu:767-776).
         ``data`` may be a host array or a :class:`..plan.StagedSegment` the
         pipeline pre-placed on the device (see :meth:`stage_segment`)."""
+        self._count_segment("encode", data)
         return self._matmul(self.parity_block, data)
 
     def decode(self, decode_mat, chunks):
         """(k, k) recovery matrix x (k, m) surviving chunks -> (k, m) natives."""
+        self._count_segment("decode", chunks)
         return self._matmul(decode_mat, chunks)
 
     def stage_segment(self, seg, *, cap=None, sym: int = 1, out_rows=None):
@@ -250,11 +264,20 @@ class RSCodec:
                         stacklevel=3,
                     )
                     self.strategy = "bitplane"
+                    _obs_metrics.counter(
+                        "rs_pallas_demotions_total",
+                        "fused-kernel failures demoted to the bitplane path",
+                    ).labels(path="local", error=type(e).__name__).inc()
                     if staged and seg.host is not None and B.is_deleted():
                         # The failed dispatch DONATED the staged device
                         # buffer before raising; re-stage from the retained
                         # host copy so the demoted recompute below reads
                         # real data, not a deleted array.
+                        _obs_metrics.counter(
+                            "rs_donation_restages_total",
+                            "donated buffers re-staged from the host copy "
+                            "after a donating dispatch failed",
+                        ).inc()
                         B = jax.device_put(seg.host)
             if use_plan:
                 return _plan.dispatch(
@@ -314,6 +337,10 @@ class RSCodec:
                     stacklevel=3,
                 )
                 self.strategy = "bitplane"
+                _obs_metrics.counter(
+                    "rs_pallas_demotions_total",
+                    "fused-kernel failures demoted to the bitplane path",
+                ).labels(path="mesh", error=type(e).__name__).inc()
         out = _sharded(np.asarray(A), Bd, self.strategy)
         return out[:, :m] if pad else out
 

@@ -1,0 +1,16 @@
+"""Unified observability layer: metrics registry + span tracing.
+
+One instrumentation surface for the whole codebase (docs/OBSERVABILITY.md):
+
+- :mod:`.metrics` — process-wide counters/gauges/histograms with labeled
+  children, dict snapshot and Prometheus text exposition.  Off by default;
+  ``RS_METRICS=1`` (or :func:`.metrics.force_enable`) turns collection on.
+- :mod:`.tracing` — per-segment span tracer exporting Chrome-trace /
+  Perfetto JSON.  Off by default; ``RS_TRACE=<path>`` (or a
+  ``trace_path=`` argument on the file APIs) turns it on.
+
+Both modules are stdlib-only imports (no jax/numpy) so any layer can be
+instrumented without import-cost or backend-init concerns.
+"""
+
+from . import metrics, tracing  # noqa: F401 (the public surface)

@@ -1,0 +1,406 @@
+"""Process-wide metrics registry — counters, gauges, bucketed histograms.
+
+The reference tool's only observability was cudaEvent step timing and one
+aggregate computation/communication report (encode.cu:111-163, 227-232);
+this reproduction outgrew that — the plan cache, the autotune calibration
+and the staging ring each carried private counters with private dump
+tools.  This module is the one instrumentation layer they all feed:
+
+* **Metric types** — :class:`Counter` (monotonic), :class:`Gauge`
+  (set/inc/dec), :class:`Histogram` (bucketed, cumulative ``le`` counts +
+  sum/count).  Every metric supports *labeled children*
+  (``counter("segments_dispatched").labels(op="encode", strategy="pallas")
+  .inc()``) so one name covers a family of time series.
+* **Registry** — a thread-safe process-wide name -> metric table with
+  ``snapshot()`` (plain dict, JSON-ready) and ``render_text()``
+  (Prometheus text exposition) so the same numbers serve a CLI dump, a
+  test assertion, or a scrape endpoint.
+* **Off by default** — the module accessors (:func:`counter`,
+  :func:`gauge`, :func:`histogram`) return a shared no-op
+  :data:`NULL` unless metrics are enabled (``RS_METRICS=1`` or
+  :func:`force_enable`, which the CLI's ``--metrics-json`` / ``stats``
+  surfaces use).  The disabled path registers NOTHING and costs one env
+  read + a no-op method call per instrumentation site — guarded by a
+  tier-1 overhead test (tests/test_obs.py).
+
+Import cost: stdlib only (no jax, no numpy) — instrumented modules like
+``parallel.pipeline`` must stay importable without a backend.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+# force_enable() latch: the CLI's --metrics-json/stats surfaces must be able
+# to collect without asking the user to also export RS_METRICS=1.
+_FORCED = False
+
+
+def enabled() -> bool:
+    """Whether metrics collection is on: ``RS_METRICS`` truthy (read per
+    call so tests can monkeypatch) or :func:`force_enable` latched."""
+    return _FORCED or os.environ.get("RS_METRICS", "").lower() in _TRUTHY
+
+
+def force_enable(on: bool = True) -> None:
+    """Latch metrics on (off) regardless of ``RS_METRICS`` — the in-process
+    equivalent of exporting the env var, used by ``rs stats`` /
+    ``--metrics-json`` and by tests."""
+    global _FORCED
+    _FORCED = on
+
+
+class _Null:
+    """Shared no-op metric: every mutator is a pass, ``labels`` returns
+    itself — the whole disabled instrumentation path in one object."""
+
+    __slots__ = ()
+
+    def labels(self, **_kv):
+        return self
+
+    def inc(self, n=1):
+        pass
+
+    def dec(self, n=1):
+        pass
+
+    def set(self, v):
+        pass
+
+    def observe(self, v):
+        pass
+
+
+NULL = _Null()
+
+
+def _label_key(kv: dict) -> tuple:
+    return tuple(sorted((k, str(v)) for k, v in kv.items()))
+
+
+def _label_str(key: tuple) -> str:
+    if not key:
+        return ""
+    return "{" + ",".join(f'{k}="{v}"' for k, v in key) + "}"
+
+
+class _ChildBase:
+    __slots__ = ("_lock",)
+
+    def __init__(self, lock: threading.Lock):
+        self._lock = lock
+
+
+class _CounterChild(_ChildBase):
+    __slots__ = ("value",)
+
+    def __init__(self, lock):
+        super().__init__(lock)
+        self.value = 0
+
+    def inc(self, n=1):
+        if n < 0:
+            raise ValueError(f"counter increment must be >= 0, got {n}")
+        with self._lock:
+            self.value += n
+
+
+class _GaugeChild(_ChildBase):
+    __slots__ = ("value",)
+
+    def __init__(self, lock):
+        super().__init__(lock)
+        self.value = 0
+
+    def set(self, v):
+        with self._lock:
+            self.value = v
+
+    def inc(self, n=1):
+        with self._lock:
+            self.value += n
+
+    def dec(self, n=1):
+        with self._lock:
+            self.value -= n
+
+
+class _HistogramChild(_ChildBase):
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, lock, bounds):
+        super().__init__(lock)
+        self.bounds = bounds  # ascending upper edges; +Inf implicit
+        self.counts = [0] * (len(bounds) + 1)  # per-bucket (not cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, v):
+        # Prometheus convention: bucket "le=b" includes v == b.
+        i = bisect.bisect_left(self.bounds, v)
+        with self._lock:
+            self.counts[i] += 1
+            self.sum += v
+            self.count += 1
+
+    def cumulative(self) -> dict:
+        """``{le: cumulative count}`` including the +Inf bucket."""
+        with self._lock:
+            counts = list(self.counts)
+        out, acc = {}, 0
+        for b, c in zip(self.bounds, counts):
+            acc += c
+            out[repr(float(b))] = acc
+        out["+Inf"] = acc + counts[-1]
+        return out
+
+
+class _Metric:
+    """One named metric family: a default (label-less) series plus any
+    labeled children, sharing a single lock."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._children: dict = {}
+
+    def _new_child(self):  # pragma: no cover - overridden
+        raise NotImplementedError
+
+    def labels(self, **kv):
+        key = _label_key(kv)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._children[key] = self._new_child()
+        return child
+
+    def _default(self):
+        return self.labels()
+
+    def series(self) -> dict:
+        """``{label_string: child}`` snapshot of the family."""
+        with self._lock:
+            return dict(self._children)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def _new_child(self):
+        return _CounterChild(self._lock)
+
+    def inc(self, n=1):
+        self._default().inc(n)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def _new_child(self):
+        return _GaugeChild(self._lock)
+
+    def set(self, v):
+        self._default().set(v)
+
+    def inc(self, n=1):
+        self._default().inc(n)
+
+    def dec(self, n=1):
+        self._default().dec(n)
+
+    @property
+    def value(self):
+        return self._default().value
+
+
+# Default edges suit the latencies this codebase measures: sub-ms dispatch
+# overheads up to multi-second compiles.
+DEFAULT_BUCKETS = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        bounds = sorted(float(b) for b in buckets)
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket edge")
+        self.buckets = tuple(bounds)
+
+    def _new_child(self):
+        return _HistogramChild(self._lock, self.buckets)
+
+    def observe(self, v):
+        self._default().observe(v)
+
+
+class Registry:
+    """Thread-safe name -> metric table.
+
+    ``get-or-create`` semantics: asking for an existing name returns the
+    existing metric (type-checked — silently returning a counter where a
+    gauge was asked for would corrupt series downstream).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name, help, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help, **kwargs)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}"
+                )
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(
+        self, name: str, help: str = "", buckets=DEFAULT_BUCKETS
+    ) -> Histogram:
+        h = self._get_or_create(Histogram, name, help, buckets=buckets)
+        if h.buckets != tuple(sorted(float(b) for b in buckets)):
+            # Same contract as the type check: silently bucketing one
+            # site's observations with another site's edges would corrupt
+            # the series downstream.
+            raise ValueError(
+                f"histogram {name!r} already registered with buckets "
+                f"{h.buckets}, requested {tuple(buckets)}"
+            )
+        return h
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def reset(self) -> None:
+        """Drop every registered metric (tests and in-process embedders)."""
+        with self._lock:
+            self._metrics.clear()
+
+    def snapshot(self) -> dict:
+        """JSON-ready dict of every registered series.
+
+        ``{name: {"type", "help", "values": {label_str: value}}}`` where a
+        histogram's value is ``{"count", "sum", "buckets": {le: cum}}``.
+        """
+        with self._lock:
+            metrics = list(self._metrics.values())
+        out = {}
+        for m in metrics:
+            values = {}
+            for key, child in m.series().items():
+                if isinstance(child, _HistogramChild):
+                    values[_label_str(key)] = {
+                        "count": child.count,
+                        "sum": child.sum,
+                        "buckets": child.cumulative(),
+                    }
+                else:
+                    values[_label_str(key)] = child.value
+            out[m.name] = {"type": m.kind, "help": m.help, "values": values}
+        return out
+
+    def render_text(self) -> str:
+        """Prometheus text exposition (scrape-format) of the registry."""
+        lines = []
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        for m in metrics:
+            if m.help:
+                lines.append(f"# HELP {m.name} {m.help}")
+            lines.append(f"# TYPE {m.name} {m.kind}")
+            for key, child in sorted(m.series().items()):
+                ls = _label_str(key)
+                if isinstance(child, _HistogramChild):
+                    for le, cum in child.cumulative().items():
+                        sep = "," if key else ""
+                        inner = ls[1:-1] if key else ""
+                        lines.append(
+                            f'{m.name}_bucket{{{inner}{sep}le="{le}"}} {cum}'
+                        )
+                    lines.append(f"{m.name}_sum{ls} {child.sum}")
+                    lines.append(f"{m.name}_count{ls} {child.count}")
+                else:
+                    lines.append(f"{m.name}{ls} {child.value}")
+        return "\n".join(lines) + ("\n" if lines else "")
+
+
+REGISTRY = Registry()
+
+
+# -- gated accessors (the instrumentation surface) ---------------------------
+#
+# Hot paths call these per event; when metrics are off they cost one env
+# read and return the shared NULL (nothing registers).  Handles are looked
+# up per call, not cached at import, so flipping RS_METRICS mid-process
+# (tests, force_enable) takes effect immediately.
+
+def counter(name: str, help: str = ""):
+    return REGISTRY.counter(name, help) if enabled() else NULL
+
+
+def gauge(name: str, help: str = ""):
+    return REGISTRY.gauge(name, help) if enabled() else NULL
+
+
+def histogram(name: str, help: str = "", buckets=DEFAULT_BUCKETS):
+    return REGISTRY.histogram(name, help, buckets) if enabled() else NULL
+
+
+def unified_snapshot() -> dict:
+    """The one observability snapshot: registry metrics + the plan-cache
+    and autotune state that used to need their own dump tools
+    (tools/plan_stats.py is now a thin shim over this).
+
+    The plan-cache sections are included even with metrics disabled — their
+    counters are load-bearing plan-layer state, always counted.  The
+    autotune section needs jax (pallas_gemm imports it); when no backend
+    is importable it degrades to an empty dict instead of failing the
+    whole snapshot.
+    """
+    out = {"metrics_enabled": enabled(), "metrics": REGISTRY.snapshot()}
+    from .. import plan
+
+    out["plan_cache"] = plan.PLAN_CACHE.stats()
+    out["mesh_plan_cache"] = plan.MESH_PLAN_CACHE.stats()
+    try:
+        from ..ops.pallas_gemm import autotune_decisions
+    except ImportError:  # jax/pallas unavailable in this process
+        out["autotune_decisions"] = {}
+    else:
+        # Real defects in the accessor or the dict build must propagate —
+        # only the missing-dependency case degrades to empty (the narrow-
+        # handling discipline of ADVICE r5 finding 1).
+        out["autotune_decisions"] = {
+            repr(k): v for k, v in sorted(
+                autotune_decisions().items(), key=repr
+            )
+        }
+    return out

@@ -97,6 +97,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..obs import metrics as _obs_metrics
 from .gf import get_field
 
 DEFAULT_TILE = 2048      # interpret / CPU-mesh default
@@ -569,6 +570,10 @@ def _autotune_refold(A, B, w, tile, acc_dtype, interpret, expand) -> str:
         if times["dot"] < _AUTOTUNE_MARGIN * times["sum"]
         else "sum"
     )
+    _obs_metrics.counter(
+        "rs_pallas_autotune_total",
+        "refold autotune calibrations by winning candidate",
+    ).labels(choice=choice, mode="eager", w=w).inc()
     with _AUTOTUNE_LOCK:
         # First writer wins: a thread that raced the same cold key already
         # proved its (identical) choice; keep the cache write-once per key.
@@ -660,6 +665,10 @@ def calibrate_aot_refold(A, B, w, compile_variant):
         if times["dot"] < _AUTOTUNE_MARGIN * times["sum"]
         else "sum"
     )
+    _obs_metrics.counter(
+        "rs_pallas_autotune_total",
+        "refold autotune calibrations by winning candidate",
+    ).labels(choice=choice, mode="aot", w=w).inc()
     if choice not in exes:
         # Both candidates failed to compile: surface the failure through
         # the caller's normal dispatch guard by compiling the default.
@@ -802,6 +811,16 @@ def gf_matmul_pallas(
         )
     A = jnp.asarray(A)
     B = jnp.asarray(B)
+    # Python-level entries: eager dispatches plus one per jit/AOT trace
+    # (inside a trace this runs once per compile, so the counter reads as
+    # "kernel builds + eager dispatches", labeled by call context).
+    _obs_metrics.counter(
+        "rs_pallas_gemm_calls_total",
+        "gf_matmul_pallas entries (eager dispatches + compile traces)",
+    ).labels(
+        w=w,
+        traced=isinstance(A, jax.core.Tracer) or isinstance(B, jax.core.Tracer),
+    ).inc()
     if expand == "pack2" and not fold_parity:
         # The pre-parity (stripe-psum) form cannot be emitted: the
         # accumulator lanes hold two packed 8-bit parity fields, not the
@@ -950,6 +969,10 @@ def gf_matmul_pallas(
             refold = _autotune_refold(
                 A, B, w, tile, acc_dtype, interpret, expand
             )
+    _obs_metrics.counter(
+        "rs_pallas_refold_total",
+        "resolved refold choices at kernel dispatch/trace time",
+    ).labels(refold=refold, expand=expand, w=w).inc()
     return _pallas_matmul(
         A, B, w, tile, acc_dtype, interpret, expand, fold=fold_parity,
         refold=refold,

@@ -19,6 +19,8 @@ import threading
 from collections.abc import Callable, Iterable
 from typing import Any, Generic, TypeVar
 
+from ..obs import metrics as _metrics, tracing as _tracing
+
 T = TypeVar("T")
 
 
@@ -39,14 +41,25 @@ class AsyncWindow(Generic[T]):
         self.consume = consume
         self._pending: list[tuple[Any, T]] = []
 
+    def _report_depth(self) -> None:
+        n = len(self._pending)
+        _metrics.gauge(
+            "rs_pipeline_inflight",
+            "async segments in flight (AsyncWindow pending futures)",
+        ).set(n)
+        _tracing.counter("pipeline_inflight", inflight=n)
+
     def push(self, tag: Any, future: T) -> None:
         self._pending.append((tag, future))
+        self._report_depth()
         while len(self._pending) > self.depth:
             self.consume(*self._pending.pop(0))
+            self._report_depth()
 
     def flush(self) -> None:
         while self._pending:
             self.consume(*self._pending.pop(0))
+            self._report_depth()
 
     def __enter__(self):
         return self
@@ -90,6 +103,14 @@ class DeviceStagingRing:
         self._staged: list = []
         self._exhausted = False
 
+    def _report_occupancy(self) -> None:
+        n = len(self._staged)
+        _metrics.gauge(
+            "rs_staging_ring_occupancy",
+            "segments staged on-device ahead of the consumer",
+        ).set(n)
+        _tracing.counter("staging_ring_occupancy", staged=n)
+
     def _fill(self) -> None:
         while not self._exhausted and len(self._staged) < self._depth:
             try:
@@ -108,6 +129,10 @@ class DeviceStagingRing:
             raise StopIteration
         tag, staged = self._staged.pop(0)
         self._fill()  # issue the next H2D before handing this segment out
+        # ONE sample per handed-out segment, after pop+refill: steady state
+        # reads depth, the tail drain (source exhausted, ring emptying)
+        # shows the occupancy actually falling to zero.
+        self._report_occupancy()
         return tag, staged
 
 

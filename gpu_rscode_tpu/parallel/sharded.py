@@ -39,6 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 # covered by the oracle-equality tests on the virtual mesh.
 shard_map = functools.partial(jax.shard_map, check_vma=False)
 
+from ..obs import metrics as _metrics, tracing as _tracing
 from ..ops import gemm as _gemm
 from ..ops.gf import get_field
 from .mesh import COLS, STRIPE
@@ -151,6 +152,14 @@ def put_sharded(B, mesh, stripe_sharded: bool = False):
     """
     spec = P(STRIPE if stripe_sharded else None, COLS)
     sharding = NamedSharding(mesh, spec)
-    if jax.process_count() > 1:
-        return jax.make_array_from_process_local_data(sharding, B)
-    return jax.device_put(B, sharding)
+    _metrics.counter(
+        "rs_mesh_segments_staged_total",
+        "segments placed onto a device mesh (put_sharded)",
+    ).labels(stripe=stripe_sharded, procs=jax.process_count()).inc()
+    with _tracing.span(
+        "mesh_stage", lane="stage", cols=int(B.shape[1]),
+        stripe=bool(stripe_sharded),
+    ):
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, B)
+        return jax.device_put(B, sharding)

@@ -56,9 +56,12 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from collections import OrderedDict
 
 import numpy as np
+
+from .obs import metrics as _metrics, tracing as _tracing
 
 
 def enabled() -> bool:
@@ -175,7 +178,14 @@ def stage_segment(B, cap: int | None, retain_host: bool = True):
 
     padded = _pad_to(B, bucket_cols(B.shape[1], cap))
     host = padded if retain_host and _donation_allowed() else None
-    return StagedSegment(jax.device_put(padded), B.shape[1], cap, host=host)
+    with _tracing.span("h2d_stage", lane="stage", cols=int(B.shape[1]),
+                       bucket=int(padded.shape[1])):
+        staged = jax.device_put(padded)
+    _metrics.counter(
+        "rs_segments_staged_total",
+        "segments bucket-padded and staged onto the device (H2D issued)",
+    ).inc()
+    return StagedSegment(staged, B.shape[1], cap, host=host)
 
 
 class ExecutionPlan:
@@ -184,7 +194,7 @@ class ExecutionPlan:
 
     __slots__ = (
         "key", "strategy", "w", "bucket", "refold", "calls", "donated_calls",
-        "_compiled", "_lock",
+        "compile_seconds", "_compiled", "_lock",
     )
 
     def __init__(self, key, strategy, w, bucket):
@@ -195,6 +205,7 @@ class ExecutionPlan:
         self.refold = None          # pallas plans: resolved at first compile
         self.calls = 0
         self.donated_calls = 0
+        self.compile_seconds = 0.0  # lower+compile wall across all variants
         self._compiled: dict = {}   # donate(bool) -> jax Compiled
         self._lock = threading.Lock()   # serializes this plan's builds
 
@@ -204,10 +215,22 @@ class ExecutionPlan:
         import jax
 
         jitted = jax.jit(fn, donate_argnums=(1,) if donate else ())
-        return jitted.lower(
-            jax.ShapeDtypeStruct(A.shape, A.dtype),
-            jax.ShapeDtypeStruct(B.shape, B.dtype),
-        ).compile()
+        t0 = time.perf_counter()
+        with _tracing.span(
+            "plan_compile", lane="compile", strategy=self.strategy,
+            bucket=int(self.bucket), donate=donate,
+        ):
+            exe = jitted.lower(
+                jax.ShapeDtypeStruct(A.shape, A.dtype),
+                jax.ShapeDtypeStruct(B.shape, B.dtype),
+            ).compile()
+        dt = time.perf_counter() - t0
+        self.compile_seconds += dt  # under the plan's own lock (see run())
+        _metrics.histogram(
+            "rs_plan_compile_seconds",
+            "wall seconds spent in AOT lower+compile per plan variant",
+        ).labels(strategy=self.strategy).observe(dt)
+        return exe
 
     def _build(self, A, B, donate: bool):
         """Lower + compile this plan's executable for concrete operands.
@@ -268,6 +291,10 @@ class ExecutionPlan:
             self.calls += 1
             if donate:
                 self.donated_calls += 1
+        _metrics.counter(
+            "rs_plan_dispatch_total",
+            "GEMM dispatches through cached plan executables",
+        ).labels(strategy=self.strategy, donated=donate).inc()
         return exe(A, B)
 
     def describe(self) -> dict:
@@ -286,6 +313,7 @@ class ExecutionPlan:
             ) or (["jit"] if self.key[6][0] != "local" else []),
             "calls": self.calls,
             "donated_calls": self.donated_calls,
+            "compile_seconds": self.compile_seconds,
         }
 
 
@@ -300,13 +328,24 @@ class PlanCache:
     together (pair with ``jax.clear_caches()``).
     """
 
-    def __init__(self, max_size: int | None = None):
+    def __init__(self, max_size: int | None = None, name: str = "local"):
         self._lock = threading.RLock()
         self._plans: OrderedDict = OrderedDict()
         self._max_size = max_size
+        self.name = name
         self.hits = 0
         self.misses = 0
         self.evictions = 0
+
+    def _count_event(self, event: str) -> None:
+        # The plain int attributes stay authoritative (always counted —
+        # they are plan-layer contract surface, metrics on or off); the
+        # registry mirror makes them part of the unified snapshot's
+        # metric families when RS_METRICS is on.
+        _metrics.counter(
+            "rs_plan_cache_events_total",
+            "plan cache lookups by outcome",
+        ).labels(cache=self.name, event=event).inc()
 
     def _bound(self) -> int:
         if self._max_size is not None:
@@ -323,8 +362,10 @@ class PlanCache:
             if plan is not None:
                 self.hits += 1
                 self._plans.move_to_end(key)
+                self._count_event("hit")
                 return plan
             self.misses += 1
+            self._count_event("miss")
             plan = ExecutionPlan(key, strategy, w, bucket)
             self._plans[key] = plan
             while len(self._plans) > self._bound():
@@ -334,6 +375,7 @@ class PlanCache:
                 # rather than inheriting a decision about a dead compile.
                 self._plans.popitem(last=False)
                 self.evictions += 1
+                self._count_event("eviction")
             return plan
 
     def clear(self) -> None:
@@ -359,15 +401,18 @@ class PlanCache:
                 "max_size": self._bound(),
             }
         out["plans"] = [p.describe() for p in plans]
+        out["compile_seconds"] = sum(
+            p["compile_seconds"] for p in out["plans"]
+        )
         return out
 
-PLAN_CACHE = PlanCache()
+PLAN_CACHE = PlanCache(name="local")
 # Mesh dispatches are counter-only entries (the executable lives in the
 # jitted collective's own cache, keyed by EXACT shapes — so they are
 # counted by exact width, which reflects real mesh compiles).  They live
 # in their own cache so unbounded mesh width churn can never evict local
 # plans that hold real AOT executables.
-MESH_PLAN_CACHE = PlanCache()
+MESH_PLAN_CACHE = PlanCache(name="mesh")
 
 
 def _pad_to(B, bucket: int):

@@ -1,12 +1,15 @@
-"""Execution-plan cache inspector — the dispatch-overhead dashboard.
+"""Execution-plan cache inspector — a thin shim over the unified
+observability snapshot (``obs.metrics.unified_snapshot``).
 
 Runs a synthetic multi-tail encode workload (several files whose chunk
 sizes produce different tail-segment widths — exactly the shapes that used
-to cost one XLA trace+compile EACH) and dumps the plan cache: hit/miss
-counters, the executables it holds, and the bucket-ladder bound the
-workload should respect.  The final stdout line is machine-readable JSON
-(the same one-line contract as the benches); ``--no-workload`` skips the
-synthetic encodes and dumps whatever the current process accumulated.
+to cost one XLA trace+compile EACH) and dumps the unified snapshot: the
+plan cache's hit/miss counters and executables, the autotune decisions,
+and — under ``RS_METRICS=1`` — the full metrics registry.  The final
+stdout line is machine-readable JSON (the same one-line contract as the
+benches); ``--no-workload`` skips the synthetic encodes and dumps whatever
+the current process accumulated.  ``rs stats --workload`` is the CLI
+surface over the same :func:`run_workload`.
 
 Usage: python -m gpu_rscode_tpu.tools.plan_stats \
            [--k 4] [--p 2] [--seg-kb 4] [--tails 520 652 776 1000] [--w 8]
@@ -32,6 +35,43 @@ def _ladder_bound(seg_cols: int) -> int:
     return len({plan.bucket_cols(m, seg_cols) for m in range(1, seg_cols + 1)})
 
 
+def _seg_cols(k: int, seg_bytes: int, w: int) -> int:
+    """The SAME segment width (in symbols) the live encode derives
+    (api._segment_cols applies 128-lane down-alignment; the synthetic
+    chunks are larger than one segment, so the alignment branch always
+    applies).  One copy — run_workload and the --no-workload dump must
+    never diverge on this."""
+    from .. import api
+
+    return api._segment_cols(1 << 62, k, seg_bytes) // (w // 8)
+
+
+def run_workload(
+    k: int = 4, p: int = 2, seg_bytes: int = 4096,
+    tails=(520, 652, 776, 1000), w: int = 8,
+) -> int:
+    """Clear the plan cache and encode one synthetic multi-tail file per
+    tail width (the dispatch-overhead probe workload).  Returns the
+    segment column width the workload's plan caps derive from."""
+    from .. import api, plan
+
+    sym = w // 8
+    seg_cols = _seg_cols(k, seg_bytes, w)
+    plan.PLAN_CACHE.clear()
+    rng = np.random.default_rng(0)
+    with tempfile.TemporaryDirectory() as d:
+        for tail in tails:
+            chunk = (2 * seg_cols + tail) * sym
+            path = os.path.join(d, f"t{tail}.bin")
+            open(path, "wb").write(
+                rng.integers(
+                    0, 256, size=k * chunk, dtype=np.uint8
+                ).tobytes()
+            )
+            api.encode_file(path, k, p, segment_bytes=seg_bytes, w=w)
+    return seg_cols
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m gpu_rscode_tpu.tools.plan_stats"
@@ -48,33 +88,18 @@ def main(argv=None) -> int:
                     help="dump current process stats without encoding")
     args = ap.parse_args(argv)
 
-    from .. import api, plan
+    from ..obs import metrics as obs_metrics
 
     seg_bytes = args.seg_kb * 1024
-    sym = args.w // 8
-    # The SAME width the live encode derives (api._segment_cols applies
-    # 128-lane down-alignment) — the chunks synthesized below are larger
-    # than one segment, so the alignment branch always applies.
-    seg_cols = api._segment_cols(1 << 62, args.k, seg_bytes) // sym
-    if not args.no_workload:
-        plan.PLAN_CACHE.clear()
-        rng = np.random.default_rng(0)
-        with tempfile.TemporaryDirectory() as d:
-            for tail in args.tails:
-                chunk = (2 * seg_cols + tail) * sym
-                path = os.path.join(d, f"t{tail}.bin")
-                open(path, "wb").write(
-                    rng.integers(
-                        0, 256, size=args.k * chunk, dtype=np.uint8
-                    ).tobytes()
-                )
-                api.encode_file(
-                    path, args.k, args.p, segment_bytes=seg_bytes, w=args.w
-                )
+    if args.no_workload:
+        seg_cols = _seg_cols(args.k, seg_bytes, args.w)
+    else:
+        seg_cols = run_workload(
+            args.k, args.p, seg_bytes, tuple(args.tails), args.w
+        )
 
-    from ..ops.pallas_gemm import autotune_decisions
-
-    stats = plan.PLAN_CACHE.stats()
+    snap = obs_metrics.unified_snapshot()
+    stats = snap["plan_cache"]
     encode_execs = [
         pl for pl in stats["plans"] if pl["a_shape"] == [args.p, args.k]
     ]
@@ -83,8 +108,9 @@ def main(argv=None) -> int:
         "stats": stats,
         "encode_executables": len(encode_execs),
         "ladder_bound": _ladder_bound(seg_cols),
-        "mesh_registered": plan.MESH_PLAN_CACHE.stats()["executables"],
-        "autotune_decisions": len(autotune_decisions()),
+        "mesh_registered": snap["mesh_plan_cache"]["executables"],
+        "autotune_decisions": len(snap["autotune_decisions"]),
+        "metrics_enabled": snap["metrics_enabled"],
     }
     print(json.dumps(out), flush=True)
     return 0

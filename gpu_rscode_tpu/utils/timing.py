@@ -7,53 +7,100 @@ device work is timed by bracketing ``block_until_ready`` fences around
 phases, host IO by wall clock.  The report keeps the reference's
 computation/communication split so numbers are comparable.
 
+Phases integrate with the unified observability layer (``..obs``): when a
+tracing session is active (``RS_TRACE``), every timed phase also lands as
+a span on the ``phase`` lane of the exported Perfetto trace — the timer
+stays the human-readable report, the trace the per-event timeline.
+
 For deep profiling use ``jax.profiler.trace`` via the ``profile_dir``
 option on the file APIs (the TPU-native answer to nvprof/ptxas stats).
 """
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 
+from ..obs import tracing as _tracing
+
 
 class PhaseTimer:
-    """Accumulates named phase durations; phases tagged 'io'/'transfer' count
-    as communication, the rest as computation."""
+    """Accumulates named phase durations.
 
-    COMM_PHASES = ("read", "write", "transfer", "io", "stage")
+    Communication phases are identified by an explicit parenthesized tag
+    suffix — ``"stage segment (io)"`` — checked against :data:`COMM_TAGS`
+    exactly, never by substring (a phase merely *containing* "io", like
+    "dispatch ratio" or "prioritize", must not silently count as
+    communication).
+    """
+
+    # Comm-tag vocabulary: a phase named "... (<tag>)" with <tag> in this
+    # set counts as communication; everything else is computation.
+    COMM_TAGS = frozenset({"io", "transfer", "stage"})
 
     def __init__(self, enabled: bool = True):
         self.enabled = enabled
         self.acc: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
+        self.best: dict[str, float] = {}  # per-phase minimum duration
         self._t0 = time.perf_counter()
+
+    @classmethod
+    def is_comm(cls, name: str) -> bool:
+        """Exact comm-tag classification (see class docstring)."""
+        if not name.endswith(")") or "(" not in name:
+            return False
+        return name[name.rfind("(") + 1 : -1] in cls.COMM_TAGS
+
+    def _record(self, name: str, dt: float) -> None:
+        self.acc[name] += dt
+        self.counts[name] += 1
+        prev = self.best.get(name)
+        if prev is None or dt < prev:
+            self.best[name] = dt
 
     @contextmanager
     def phase(self, name: str):
         if not self.enabled:
-            yield
+            # A disabled timer never accumulates, but an active RS_TRACE
+            # session still gets the phase span — the file APIs default to
+            # a disabled timer, and the trace must not go blind there.
+            if _tracing.active() is None:
+                yield
+                return
+            with _tracing.span(
+                name, lane="phase:" + threading.current_thread().name
+            ):
+                yield
             return
         t = time.perf_counter()
         try:
-            yield
+            # Lane per thread: the prefetch worker's IO phases overlap the
+            # consumer's compute phases; same-lane X events must nest.
+            with _tracing.span(
+                name, lane="phase:" + threading.current_thread().name
+            ):
+                yield
         finally:
-            dt = time.perf_counter() - t
-            self.acc[name] += dt
-            self.counts[name] += 1
+            self._record(name, time.perf_counter() - t)
 
     def add(self, name: str, seconds: float) -> None:
-        self.acc[name] += seconds
-        self.counts[name] += 1
+        """Record an externally measured duration (same accounting as a
+        :meth:`phase` block).  Honours ``enabled`` — a disabled timer must
+        never mutate its accumulators."""
+        if not self.enabled:
+            return
+        self._record(name, seconds)
 
     @property
     def total(self) -> float:
         return time.perf_counter() - self._t0
 
     def summary(self, data_bytes: int | None = None) -> str:
-        comm = sum(v for k, v in self.acc.items() if any(t in k for t in self.COMM_PHASES))
-        comp = sum(v for k, v in self.acc.items() if not any(t in k for t in self.COMM_PHASES))
+        comm = sum(v for k, v in self.acc.items() if self.is_comm(k))
+        comp = sum(v for k, v in self.acc.items() if not self.is_comm(k))
         lines = [
             f"  {name}: {1e3 * v:.3f} ms  (x{self.counts[name]})"
             for name, v in sorted(self.acc.items())
