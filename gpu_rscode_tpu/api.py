@@ -26,8 +26,11 @@ import os
 
 import numpy as np
 
+from contextlib import contextmanager, nullcontext
+
 from .codec import RSCodec
 from .obs import metrics as _obs_metrics, tracing as _obs_tracing
+from .parallel.io_executor import DrainExecutor, FleetPipeline
 from .parallel.pipeline import AsyncWindow, DeviceStagingRing, SegmentPrefetcher
 from .utils.fileformat import (
     append_checksums,
@@ -158,6 +161,40 @@ def _staging_ring(
     )
 
 
+@contextmanager
+def _fleet_lane():
+    """The fleet scaffold every multi-file entry point shares: one ordered
+    write-behind lane, archives committed behind their own writes, and —
+    the ordering-sensitive part — ``abort`` (running the still-registered
+    cleanups) only AFTER the executor context exited with its workers
+    joined, so no in-flight drain races a cleanup's closes/unlinks."""
+    pipe = FleetPipeline(DrainExecutor(ordered=True))
+    try:
+        with pipe.executor:
+            yield pipe
+    except BaseException:
+        pipe.abort()
+        raise
+
+
+def _drain_ctx(fleet: FleetPipeline | None, *, ordered: bool = True):
+    """Write-behind executor for one dispatch loop (the 5th pipeline stage:
+    write ∥ dispatch — see parallel/io_executor.py and docs/IO.md).
+
+    Inside a fleet operation the loop rides the fleet's shared ordered
+    lane (not closed per file — archive j+1's dispatches overlap archive
+    j's drain); standalone it owns a fresh ``DrainExecutor`` whose context
+    exit is the write barrier, placed inside the caller's ``with`` block so
+    every write lands before files are closed or promoted.  ``ordered``
+    must stay True for drains with cross-segment state (incremental CRC,
+    shared-``fp`` streaming writes); the offset-addressed ``os.pwrite``
+    collectives pass False to let ``RS_IO_WRITERS`` workers race.
+    """
+    if fleet is not None:
+        return nullcontext(fleet.executor)
+    return DrainExecutor(ordered=ordered)
+
+
 def _dispatch_span(op: str, off: int, cols: int):
     """Per-segment dispatch span (one per dispatched segment, with its
     column range in args — the trace's unit of accountability)."""
@@ -267,12 +304,20 @@ def _write_native_chunks(
     copy_step: int,
     crcs: dict[int, int] | None,
     timer: PhaseTimer,
+    executor=None,
 ) -> None:
     """Write the k native chunk temp files: straight copies of the k file
     ranges, tail zero-padded, in bounded slices (a 100 GB chunk never
-    materialises in RAM), with optional incremental CRC32."""
-    with timer.phase("write natives (io)"):
-        for i in range(k):
+    materialises in RAM), with optional incremental CRC32.
+
+    With an ``executor`` (the encode's write-behind lane) each chunk copy
+    is queued as a drain task instead of running here: the dispatch thread
+    proceeds straight to parity streaming while the natives land on the
+    writer lane (tasks touch distinct files and distinct ``crcs`` keys, so
+    lane ordering is irrelevant; ``src`` is a read-only view)."""
+
+    def write_one(i: int) -> None:
+        with timer.phase("write natives (io)"):
             lo, hi = i * chunk, min((i + 1) * chunk, total_size)
             crc = 0
             with open(tmps[chunk_file_name(file_name, i)], "wb") as fp:
@@ -291,6 +336,12 @@ def _write_native_chunks(
             if crcs is not None:
                 crcs[i] = crc
 
+    for i in range(k):
+        if executor is not None:
+            executor.submit(lambda i=i: write_one(i), nbytes=chunk)
+        else:
+            write_one(i)
+
 
 @_observed_file_op("encode")
 def encode_file(
@@ -307,6 +358,7 @@ def encode_file(
     checksums: bool = False,
     w: int = 8,
     timer: PhaseTimer | None = None,
+    _fleet: FleetPipeline | None = None,
 ) -> list[str]:
     """Encode ``file_name`` into n = k + p chunk files plus .METADATA.
 
@@ -342,6 +394,11 @@ def encode_file(
     seg_cols = _segment_cols(chunk, k, segment_bytes)
 
     if len(_mesh_processes(mesh)) > 1:
+        if _fleet is not None:
+            raise ValueError(
+                "fleet encode is single-host; multi-process encodes are "
+                "collectives with their own barriers"
+            )
         return _encode_file_multiprocess(
             file_name, codec, chunk, total_size, seg_cols,
             checksums=checksums, pipeline_depth=pipeline_depth, timer=timer,
@@ -379,60 +436,26 @@ def encode_file(
             )
 
     parity_files: list = []
-    try:
-        _write_native_chunks(
-            src, file_name, tmps, k, chunk, total_size, copy_step, crcs, timer
-        )
 
-        # Parity chunks: stream segments through the device, staging on a
-        # worker thread (SegmentPrefetcher) so read IO overlaps the drain's
-        # D2H + parity writes — the three-way overlap of the reference's
-        # stream loop (encode.cu:165-218).
-        for j in range(p):
-            parity_files.append(
-                open(tmps[chunk_file_name(file_name, k + j)], "wb")
-            )
-        try:
-            with SegmentPrefetcher(
-                _segment_spans(chunk, seg_cols), gather_segment,
-                depth=pipeline_depth,
-            ) as prefetch, AsyncWindow(
-                pipeline_depth,
-                lambda tag, fut: _drain_parity(
-                    (*tag, fut), parity_files, timer, crcs, k
-                ),
-            ) as window:
-                # 3-stage pipeline: the ring issues segment i+1's H2D (an
-                # async device_put of the bucket-padded segment, see
-                # plan.py) while segment i computes and segment i-1 drains
-                # its D2H + parity writes through the window.
-                staging = _staging_ring(
-                    prefetch, codec, seg_cols, sym, pipeline_depth,
-                    out_rows=codec.parity_block.shape[0],
-                )
-                for (off, cols), seg in staging:
-                    with timer.phase("encode dispatch"), _dispatch_span(
-                        "encode", off, cols
-                    ):
-                        parity = codec.encode(seg)  # async
-                    window.push((off, cols), parity)
-        finally:
-            for fp in parity_files:
-                fp.close()
-
+    def finalize() -> None:
+        # The commit tail: runs only after every parity write landed — on
+        # the caller thread standalone, on the fleet's writer lane (behind
+        # this file's drains) in batch mode.
+        for fp in parity_files:
+            fp.close()
         meta_tmp = tmps[metadata_file_name(file_name)]
         with timer.phase("write metadata (io)"):
             write_metadata(meta_tmp, total_size, p, k, codec.total_matrix, w=w)
             if crcs is not None:
                 append_checksums(meta_tmp, crcs)
-
         # Commit: chunks first, .METADATA last — its presence is the marker
         # of a complete encode.
         for name in written[:-1]:
             os.replace(tmps[name], name)
             committed.append(name)
         os.replace(meta_tmp, metadata_file_name(file_name))
-    except BaseException:
+
+    def cleanup() -> None:
         for fp in parity_files:
             if not fp.closed:
                 fp.close()
@@ -448,6 +471,64 @@ def encode_file(
         for name in committed:
             if name not in preexisting and os.path.exists(name):
                 os.unlink(name)
+
+    # In a fleet, cleanup is registered up front and runs via the fleet's
+    # abort (after its workers joined) — never inline, where it would race
+    # this file's still-queued drains on the shared lane.
+    key = _fleet.register(cleanup) if _fleet is not None else None
+    try:
+        with _drain_ctx(_fleet) as dex:
+            # Native chunk copies ride the writer lane too: the dispatch
+            # thread proceeds straight to parity streaming while the k
+            # straight copies land write-behind (sync with RS_IO_WRITERS=0).
+            _write_native_chunks(
+                src, file_name, tmps, k, chunk, total_size, copy_step,
+                crcs, timer, executor=dex,
+            )
+
+            # Parity chunks: stream segments through the device, staging
+            # on a worker thread (SegmentPrefetcher) so read IO overlaps
+            # the drain's D2H + parity writes — the three-way overlap of
+            # the reference's stream loop (encode.cu:165-218).
+            for j in range(p):
+                parity_files.append(
+                    open(tmps[chunk_file_name(file_name, k + j)], "wb")
+                )
+            with SegmentPrefetcher(
+                _segment_spans(chunk, seg_cols), gather_segment,
+                depth=pipeline_depth,
+            ) as prefetch, AsyncWindow(
+                pipeline_depth,
+                lambda tag, fut: _drain_parity(
+                    (*tag, fut), parity_files, timer, crcs, k
+                ),
+                executor=dex,
+            ) as window:
+                # 5-stage pipeline: the prefetcher reads segment i+2, the
+                # ring issues segment i+1's H2D (an async device_put of the
+                # bucket-padded segment, see plan.py) while segment i
+                # computes, and the write-behind executor drains segment
+                # i-1's D2H + parity writes off the dispatch thread.
+                # Ordered lane: the incremental parity CRC (and the
+                # no-toolchain seek/write fallback) need commits in column
+                # order.
+                staging = _staging_ring(
+                    prefetch, codec, seg_cols, sym, pipeline_depth,
+                    out_rows=codec.parity_block.shape[0],
+                )
+                for (off, cols), seg in staging:
+                    with timer.phase("encode dispatch"), _dispatch_span(
+                        "encode", off, cols
+                    ):
+                        parity = codec.encode(seg)  # async
+                    window.push((off, cols), parity)
+        if _fleet is not None:
+            _fleet.commit(key, finalize)
+        else:
+            finalize()
+    except BaseException:
+        if _fleet is None:
+            cleanup()
         raise
     return written
 
@@ -607,9 +688,14 @@ def _encode_file_multiprocess(
                                 off + col0,
                             )
 
+            # Out-of-order write-behind: every drain is an os.pwrite at its
+            # own offset into pre-sized temps (no cross-segment state), so
+            # RS_IO_WRITERS workers may race freely.
             with SegmentPrefetcher(
                 _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
-            ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+            ) as prefetch, _drain_ctx(None, ordered=False) as dex, AsyncWindow(
+                pipeline_depth, drain, executor=dex
+            ) as window:
                 for (off, cols), local_seg in prefetch:
                     with timer.phase("encode dispatch"), _dispatch_span(
                         "encode", off, cols
@@ -663,6 +749,91 @@ def _encode_file_multiprocess(
     return written
 
 
+@_observed_file_op("encode_fleet")
+def encode_fleet(
+    files,
+    native_num: int,
+    parity_num: int,
+    *,
+    generator: str = "vandermonde",
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    pipeline_depth: int = 2,
+    checksums: bool = False,
+    w: int = 8,
+    timer: PhaseTimer | None = None,
+) -> dict[str, list[str]]:
+    """Encode many files back to back through one shared write-behind lane.
+
+    The fleet-level pipeline overlap: file j+1's native-chunk copies,
+    stripe reads and GEMM dispatches stream on this thread while file j's
+    parity D2H + writes drain on the shared writer lane, with each file's
+    metadata write and atomic promote committed behind its own writes.
+    The shared plan cache makes the interleave compile-free after the
+    first file (identical (k, p, w, strategy) plans).  Single-host by
+    construction (multi-process encodes are collectives — no ``mesh``).
+
+    All-or-nothing per *file* (each keeps :func:`encode_file`'s atomicity
+    contract), fail-fast across the fleet: the first failing file raises,
+    later files are not attempted, and every uncommitted file's temps are
+    cleaned up.  Returns ``{file: [paths written]}``.
+    """
+    timer = timer or PhaseTimer(enabled=False)
+    files = list(files)
+    results: dict[str, list[str]] = {}
+    with _fleet_lane() as pipe:
+        for f in files:
+            results[f] = encode_file(
+                f, native_num, parity_num,
+                generator=generator, strategy=strategy,
+                segment_bytes=segment_bytes,
+                pipeline_depth=pipeline_depth,
+                checksums=checksums, w=w, timer=timer, _fleet=pipe,
+            )
+    return results
+
+
+@_observed_file_op("decode_fleet")
+def decode_fleet(
+    files,
+    outputs: dict[str, str] | None = None,
+    *,
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    pipeline_depth: int = 2,
+    verify_checksums: bool | None = None,
+    timer: PhaseTimer | None = None,
+) -> dict[str, str]:
+    """Auto-decode many archives through one shared write-behind lane.
+
+    Batch counterpart of :func:`auto_decode_file` (survivor discovery per
+    archive, CRC-verified subset selection, conf written next to each
+    archive), with the fleet-level overlap of :func:`encode_fleet`:
+    archive j+1's scan + survivor reads + recovery dispatches run while
+    archive j's output writes drain, and each archive's truncate + atomic
+    rename commits behind its own writes.  ``outputs`` optionally maps
+    ``in_file`` to an output path (default: in place, like decode).
+
+    Fail-fast: the first unrecoverable or failing archive raises; outputs
+    already committed stay, uncommitted temps are cleaned up.  Returns
+    ``{file: output path}``.
+    """
+    timer = timer or PhaseTimer(enabled=False)
+    files = list(files)
+    outputs = outputs or {}
+    results: dict[str, str] = {}
+    with _fleet_lane() as pipe:
+        for f in files:
+            results[f] = auto_decode_file(
+                f, outputs.get(f),
+                strategy=strategy, segment_bytes=segment_bytes,
+                pipeline_depth=pipeline_depth,
+                verify_checksums=verify_checksums,
+                timer=timer, _fleet=pipe,
+            )
+    return results
+
+
 @_observed_file_op("decode")
 def decode_file(
     in_file: str,
@@ -676,6 +847,7 @@ def decode_file(
     stripe_sharded: bool = False,
     verify_checksums: bool | None = None,
     timer: PhaseTimer | None = None,
+    _fleet: FleetPipeline | None = None,
 ) -> str:
     """Rebuild ``in_file`` from the k surviving chunks listed in
     ``conf_file``.  Returns the output path (defaults to ``in_file``,
@@ -688,6 +860,11 @@ def decode_file(
     """
     timer = timer or PhaseTimer(enabled=False)
     if len(_mesh_processes(mesh)) > 1:
+        if _fleet is not None:
+            raise ValueError(
+                "fleet decode is single-host; multi-process decodes are "
+                "collectives with their own barriers"
+            )
         # The multi-process path does its own lead-verified checksum
         # pre-pass and collective recovery.
         return _decode_file_multiprocess(
@@ -802,68 +979,110 @@ def decode_file(
     # segments; the all-natives path copies through the memmaps.
     fps = [open(p, "rb") for p in paths] if dec_missing is not None else []
     try:
-        with open(tmp_path, "wb") as out_fp:
-
-            def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
-                lo = i * chunk + off
-                if lo >= total_size:
-                    return
-                hi = min(lo + cols, total_size)
-                out_fp.seek(lo)
-                out_fp.write(row_bytes[: hi - lo].tobytes())
-
-            def drain(tag, rec):
-                off, cols = tag
-                with timer.phase("decode compute"):
-                    rec_np = np.asarray(rec) if rec is not None else None
-                if rec_np is not None and rec_np.dtype != np.uint8:
-                    rec_np = np.ascontiguousarray(rec_np).view(np.uint8)  # LE
-                with timer.phase("write output (io)"):
-                    for i in range(k):
-                        if i in native_pos:
-                            src_row = maps[native_pos[i]][off : off + cols]
-                            write_row(i, off, cols, src_row)
-                        else:
-                            write_row(i, off, cols, rec_np[rec_row[i]])
-
-            from . import native
-
-            segments = _segment_spans(chunk, seg_cols)
-
-            if dec_missing is not None:
-
-                def stage(off: int, cols: int) -> np.ndarray:
-                    # Native pread gather (one syscall per surviving chunk);
-                    # memmap copies as fallback.  Runs on the prefetch
-                    # worker so read IO overlaps the drain's output writes.
-                    with timer.phase("stage segment (io)"):
-                        return native.gather_rows(
-                            fps, off, cols, fallback_maps=maps
-                        )
-
-                with SegmentPrefetcher(
-                    segments, stage, depth=pipeline_depth
-                ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
-                    staging = _staging_ring(
-                        prefetch, codec, seg_cols, sym, pipeline_depth,
-                        out_rows=dec_missing.shape[0],
-                    )
-                    for (off, cols), seg in staging:
-                        with timer.phase("decode dispatch"), _dispatch_span(
-                            "decode", off, cols
-                        ):
-                            rec = codec.decode(dec_missing, seg)  # async
-                        window.push((off, cols), rec)
-            else:
-                with AsyncWindow(pipeline_depth, drain) as window:
-                    for off, cols in segments:
-                        # all natives survived: pure copy, nothing staged
-                        window.push((off, cols), None)
-            out_fp.truncate(total_size)
-    finally:
+        out_fp = open(tmp_path, "wb")
+    except BaseException:
+        # cleanup() below closes these, but it cannot exist yet without
+        # out_fp — an unwritable output target must not leak k chunk fds.
         for fp in fps:
             fp.close()
-    os.replace(tmp_path, out_path)
+        raise
+
+    def finalize() -> None:
+        # Runs after every output write landed (standalone: after the
+        # drain executor's barrier; fleet: behind this file's drains on
+        # the shared writer lane).
+        out_fp.truncate(total_size)
+        out_fp.close()
+        for fp in fps:
+            fp.close()
+        os.replace(tmp_path, out_path)
+
+    def cleanup() -> None:
+        if not out_fp.closed:
+            out_fp.close()
+        for fp in fps:
+            if not fp.closed:
+                fp.close()
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+
+    key = _fleet.register(cleanup) if _fleet is not None else None
+    try:
+
+        def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
+            lo = i * chunk + off
+            if lo >= total_size:
+                return
+            hi = min(lo + cols, total_size)
+            out_fp.seek(lo)
+            out_fp.write(row_bytes[: hi - lo].tobytes())
+            _obs_metrics.counter(
+                "rs_io_write_bytes_total",
+                "bytes write by the staging-I/O layer",
+            ).labels(call="stream_write").inc(hi - lo)
+
+        def drain(tag, rec):
+            off, cols = tag
+            with timer.phase("decode compute"):
+                rec_np = np.asarray(rec) if rec is not None else None
+            if rec_np is not None and rec_np.dtype != np.uint8:
+                rec_np = np.ascontiguousarray(rec_np).view(np.uint8)  # LE
+            with timer.phase("write output (io)"):
+                for i in range(k):
+                    if i in native_pos:
+                        src_row = maps[native_pos[i]][off : off + cols]
+                        write_row(i, off, cols, src_row)
+                    else:
+                        write_row(i, off, cols, rec_np[rec_row[i]])
+
+        from . import native
+
+        segments = _segment_spans(chunk, seg_cols)
+
+        if dec_missing is not None:
+
+            def stage(off: int, cols: int) -> np.ndarray:
+                # Native pread gather (one syscall per surviving chunk);
+                # memmap copies as fallback.  Runs on the prefetch
+                # worker so read IO overlaps the drain's output writes.
+                with timer.phase("stage segment (io)"):
+                    return native.gather_rows(
+                        fps, off, cols, fallback_maps=maps
+                    )
+
+            # Ordered write-behind: the streaming shared-fp seek/write
+            # commit must stay in column order, but it runs on the writer
+            # lane — the dispatch loop never blocks on D2H or fp.write.
+            with SegmentPrefetcher(
+                segments, stage, depth=pipeline_depth
+            ) as prefetch, _drain_ctx(_fleet) as dex, AsyncWindow(
+                pipeline_depth, drain, executor=dex
+            ) as window:
+                staging = _staging_ring(
+                    prefetch, codec, seg_cols, sym, pipeline_depth,
+                    out_rows=dec_missing.shape[0],
+                )
+                for (off, cols), seg in staging:
+                    with timer.phase("decode dispatch"), _dispatch_span(
+                        "decode", off, cols
+                    ):
+                        rec = codec.decode(dec_missing, seg)  # async
+                    window.push((off, cols), rec)
+        else:
+            with _drain_ctx(_fleet) as dex, AsyncWindow(
+                pipeline_depth, drain, executor=dex
+            ) as window:
+                for off, cols in segments:
+                    # all natives survived: pure copy, nothing staged
+                    window.push((off, cols), None)
+        if _fleet is not None:
+            _fleet.commit(key, finalize)
+        else:
+            finalize()
+    except BaseException:
+        if _fleet is None:
+            cleanup()
+        raise
     return out_path
 
 
@@ -1206,10 +1425,16 @@ def _decode_file_multiprocess(
                             for j, i in enumerate(missing):
                                 pwrite_row(i, off + col0, data[j])
 
+                # Out-of-order write-behind (offset-addressed pwrites into
+                # the lead-pre-sized temp; no cross-segment state).
                 with SegmentPrefetcher(
                     _segment_spans(chunk, seg_cols), stage,
                     depth=pipeline_depth,
-                ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+                ) as prefetch, _drain_ctx(
+                    None, ordered=False
+                ) as dex, AsyncWindow(
+                    pipeline_depth, drain, executor=dex
+                ) as window:
                     for (off, cols), local_seg in prefetch:
                         with timer.phase("decode dispatch"), _dispatch_span(
                             "decode", off, cols
@@ -1503,11 +1728,15 @@ def _repair_streamed(
     mesh,
     stripe_sharded: bool,
     timer: PhaseTimer,
+    fleet: FleetPipeline | None = None,
 ) -> list[int]:
     """The streaming rebuild half of :func:`repair_file`: given a completed
     scan and a chosen survivor subset with its inverse, regenerate every
     unhealthy chunk.  Split out so :func:`repair_fleet` can supply inverses
-    computed in one batched on-device dispatch."""
+    computed in one batched on-device dispatch — and, with ``fleet``, ride
+    the fleet's shared write-behind lane: this archive's promote/checksum
+    commit queues behind its writes while the caller already streams the
+    next archive's reads and dispatches."""
     from .ops.gf import get_field
 
     targets = scan.unhealthy
@@ -1558,10 +1787,41 @@ def _repair_streamed(
                 surv_fps, off, cols, fallback_maps=surv_maps
             )
 
+    def finalize() -> None:
+        # Promote only after every rebuilt segment landed: standalone this
+        # runs after the drain barrier; in a fleet it queues on the ordered
+        # writer lane behind this archive's writes.
+        for t in targets:
+            out_fps[t].close()
+        for fp in surv_fps:
+            fp.close()
+        for t in targets:
+            os.replace(tmp_paths[t], chunk_file_name(in_file, t))
+        if scan.crcs:
+            with timer.phase("write metadata (io)"):
+                rewrite_checksums(
+                    metadata_file_name(in_file), {**scan.crcs, **new_crcs}
+                )
+
+    def cleanup() -> None:
+        for fp in surv_fps:
+            if not fp.closed:
+                fp.close()
+        for t, fp in out_fps.items():
+            if not fp.closed:
+                fp.close()
+            if os.path.exists(tmp_paths[t]):
+                os.unlink(tmp_paths[t])
+
+    key = fleet.register(cleanup) if fleet is not None else None
     try:
+        # Ordered write-behind lane: scatter_write's no-toolchain fallback
+        # shares fp positions and the incremental CRC needs column order.
         with SegmentPrefetcher(
             _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
-        ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+        ) as prefetch, _drain_ctx(fleet) as dex, AsyncWindow(
+            pipeline_depth, drain, executor=dex
+        ) as window:
             staging = _staging_ring(
                 prefetch, codec, seg_cols, sym, pipeline_depth,
                 out_rows=rebuild_mat.shape[0],
@@ -1572,24 +1832,14 @@ def _repair_streamed(
                 ):
                     rebuilt = codec.decode(rebuild_mat, seg)  # async GEMM
                 window.push((off, cols), rebuilt)
-        for t in targets:
-            out_fps[t].close()
-        for t in targets:
-            os.replace(tmp_paths[t], chunk_file_name(in_file, t))
-    finally:
-        for fp in surv_fps:
-            fp.close()
-        for t, fp in out_fps.items():
-            if not fp.closed:
-                fp.close()
-            if os.path.exists(tmp_paths[t]):
-                os.unlink(tmp_paths[t])
-
-    if scan.crcs:
-        with timer.phase("write metadata (io)"):
-            rewrite_checksums(
-                metadata_file_name(in_file), {**scan.crcs, **new_crcs}
-            )
+        if fleet is not None:
+            fleet.commit(key, finalize)
+        else:
+            finalize()
+    except BaseException:
+        if fleet is None:
+            cleanup()
+        raise
     return targets
 
 
@@ -1742,9 +1992,13 @@ def _repair_file_multiprocess(
                                 off + col0,
                             )
 
+            # Out-of-order write-behind (offset-addressed pwrites into the
+            # lead-pre-sized temps; CRCs recomputed from files afterwards).
             with SegmentPrefetcher(
                 _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
-            ) as prefetch, AsyncWindow(pipeline_depth, drain) as window:
+            ) as prefetch, _drain_ctx(None, ordered=False) as dex, AsyncWindow(
+                pipeline_depth, drain, executor=dex
+            ) as window:
                 for (off, cols), local_seg in prefetch:
                     with timer.phase("repair dispatch"), _dispatch_span(
                         "repair", off, cols
@@ -1808,7 +2062,12 @@ def repair_fleet(
     solved BEFORE any rebuild is written; if any archive is unscannable or
     unrecoverable, raises ValueError naming every such archive and repairs
     nothing.  Single-host (no mesh): fleet parallelism batches the
-    inversions; the per-archive rebuild GEMMs stream sequentially.
+    inversions, and the per-archive rebuild pipelines *interleave* through
+    one shared write-behind lane (parallel/io_executor.py): archive j+1's
+    survivor reads and GEMM dispatches overlap archive j's D2H + chunk
+    writes, with each archive's promote/CRC commit queued behind its own
+    writes.  The shared plan cache means the interleave adds zero
+    compiles; ``RS_IO_WRITERS=0`` restores the fully sequential rebuild.
 
     Returns ``{file: [rebuilt chunk indices]}`` ([] for healthy archives).
     """
@@ -1909,24 +2168,31 @@ def repair_fleet(
             "unrecoverable archives (nothing repaired): "
             + "; ".join(f"{f}: {msg}" for f, msg in sorted(errors.items()))
         )
+    # Fleet scheduler: one shared ordered write-behind lane; each archive
+    # commits behind its own writes while the next archive's reads and
+    # dispatches already stream on this thread.
     results: dict[str, list[int]] = {}
-    for f in files:
-        s = scans[f]
-        if not s.unhealthy:
-            results[f] = []
-        elif s.chunk == 0:
-            # Zero-size archives take repair_file's empty-rebuild path.
-            results[f] = repair_file(
-                f, strategy=strategy, segment_bytes=segment_bytes,
-                pipeline_depth=pipeline_depth, timer=timer,
-            )
-        else:
-            chosen, inv = chosen_inv[f]
-            results[f] = _repair_streamed(
-                f, s, chosen, inv, strategy=strategy,
-                segment_bytes=segment_bytes, pipeline_depth=pipeline_depth,
-                mesh=None, stripe_sharded=False, timer=timer,
-            )
+    with _fleet_lane() as pipe:
+        for f in files:
+            s = scans[f]
+            if not s.unhealthy:
+                results[f] = []
+            elif s.chunk == 0:
+                # Zero-size archives take repair_file's empty-rebuild
+                # path (no streamed writes to overlap).
+                results[f] = repair_file(
+                    f, strategy=strategy, segment_bytes=segment_bytes,
+                    pipeline_depth=pipeline_depth, timer=timer,
+                )
+            else:
+                chosen, inv = chosen_inv[f]
+                results[f] = _repair_streamed(
+                    f, s, chosen, inv, strategy=strategy,
+                    segment_bytes=segment_bytes,
+                    pipeline_depth=pipeline_depth,
+                    mesh=None, stripe_sharded=False, timer=timer,
+                    fleet=pipe,
+                )
     return results
 
 

@@ -31,6 +31,9 @@ from .utils.timing import PhaseTimer
 _USAGE = """Usage:
 [-h]: show usage information
 Encode: [-k|-K nativeBlockNum] [-n|-N totalBlockNum] [-e|-E fileName]
+        (extra positional files after the flags encode a whole batch
+        through one shared write-behind lane: file j+1 reads/dispatches
+        while file j's writes drain)
 Decode: [-d|-D] [-i|-I originalFileName] [-c|-C config] [-o|-O output]
 For encoding, the -k, -n, and -e options are all necessary.
 For decoding, the -d, -i, and -c options are all necessary.
@@ -52,7 +55,9 @@ Extensions: [--generator vandermonde|cauchy]
             [--width 8|16] (encode: GF symbol width; 16 = wide-symbol
             extension recorded in .METADATA, decode auto-detects)
             [--auto] (decode without -c: discover healthy chunks, skip
-            corrupt ones via CRC32, pick a decodable subset)
+            corrupt ones via CRC32, pick a decodable subset.  Extra
+            positional archives after the flags decode a whole batch
+            through one shared write-behind lane)
             [--repair] (with -i: rebuild every lost/corrupt chunk in place,
             parity included; refreshes CRC lines.  Extra positional files
             after the flags repair a whole fleet: all survivor-matrix
@@ -152,7 +157,14 @@ def main(argv: list[str] | None = None) -> int:
         )
     except getopt.GetoptError as e:
         return _fail(f"rs: {e}")
-    if extra and not any(fl == "--repair" for fl, _ in opts):
+    flags_seen = {fl.lower() for fl, _ in opts}
+    # Batch (fleet) surfaces take positional files after the flags:
+    # --repair (fleet repair), -e (batch encode), -d --auto (batch decode).
+    if extra and not (
+        "--repair" in flags_seen
+        or "-e" in flags_seen
+        or ("-d" in flags_seen and "--auto" in flags_seen)
+    ):
         return _fail(f"rs: unexpected arguments {extra}")
 
     native_num = total_num = 0
@@ -277,6 +289,15 @@ def main(argv: list[str] | None = None) -> int:
         )
     if stripe > 1 and not n_devices:
         return _fail("rs: --stripe requires --devices")
+    if extra and op in ("encode", "decode"):
+        # Batch encode/decode stream through the single-host fleet lane.
+        if n_devices:
+            return _fail(f"rs: batch {op} does not take --devices")
+        if op == "decode" and out_file:
+            return _fail(
+                "rs: batch --auto decode does not take -o "
+                "(outputs are written in place, one per archive)"
+            )
 
     if metrics_json:
         # Fail fast on an unwritable snapshot path — AFTER every pure
@@ -354,17 +375,34 @@ def main(argv: list[str] | None = None) -> int:
                 return _fail("rs: encoding requires -k, -n and -e")
             if total_num <= native_num:
                 return _fail(f"rs: need n > k (got n={total_num}, k={native_num})")
-            api.encode_file(
-                in_file,
-                native_num,
-                total_num - native_num,
-                generator=generator,
-                checksums=checksum,
-                w=width,
-                timer=timer,
-                **kwargs,
-            )
-            nbytes = os.path.getsize(in_file)
+            if extra:
+                # Batch encode: -e <first> plus positional files, one
+                # shared write-behind lane (--devices rejected above, so
+                # kwargs carries no mesh here).
+                fleet = [in_file] + list(extra)
+                api.encode_fleet(
+                    fleet,
+                    native_num,
+                    total_num - native_num,
+                    generator=generator,
+                    checksums=checksum,
+                    w=width,
+                    timer=timer,
+                    **kwargs,
+                )
+                nbytes = sum(os.path.getsize(f) for f in fleet)
+            else:
+                api.encode_file(
+                    in_file,
+                    native_num,
+                    total_num - native_num,
+                    generator=generator,
+                    checksums=checksum,
+                    w=width,
+                    timer=timer,
+                    **kwargs,
+                )
+                nbytes = os.path.getsize(in_file)
         elif op == "scrub":
             import json
 
@@ -404,7 +442,19 @@ def main(argv: list[str] | None = None) -> int:
         else:
             if not in_file or (not conf_file and not auto):
                 return _fail("rs: decoding requires -i and -c (or --auto)")
-            if auto:
+            if auto and extra:
+                # Batch decode: -i <first> plus positional archives, one
+                # shared write-behind lane (--devices/-o rejected above).
+                fleet = [in_file] + list(extra)
+                results = api.decode_fleet(
+                    fleet,
+                    verify_checksums=False if no_verify else None,
+                    timer=timer, **kwargs,
+                )
+                for f in fleet:
+                    print(f"{f}: decoded -> {results[f]}")
+                nbytes = sum(os.path.getsize(results[f]) for f in fleet)
+            elif auto:
                 out = api.auto_decode_file(
                     in_file, out_file,
                     verify_checksums=False if no_verify else None,
@@ -416,7 +466,8 @@ def main(argv: list[str] | None = None) -> int:
                     verify_checksums=False if no_verify else None,
                     timer=timer, **kwargs,
                 )
-            nbytes = os.path.getsize(out)
+            if not (auto and extra):
+                nbytes = os.path.getsize(out)
     except (ValueError, FileNotFoundError, OSError) as e:
         print(f"rs: error: {e}", file=sys.stderr)
         return 1

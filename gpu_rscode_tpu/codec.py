@@ -189,7 +189,18 @@ class RSCodec:
         from . import plan as _plan
 
         if self.mesh is not None or self.strategy == "cpu" or not _plan.enabled():
+            # Mesh placement happens in _matmul (put_sharded), host codec
+            # never leaves the host: both count as passthrough stages so
+            # the rs_io_* balance (docs/IO.md) still sees the segment.
+            _obs_metrics.counter(
+                "rs_io_h2d_bytes_total",
+                "segment bytes entering the H2D stage of the pipeline",
+            ).labels(path="passthrough").inc(seg.nbytes)
             return seg
+        _obs_metrics.counter(
+            "rs_io_h2d_bytes_total",
+            "segment bytes entering the H2D stage of the pipeline",
+        ).labels(path="plan").inc(seg.nbytes)
         return _plan.stage_segment(
             seg, cap,
             retain_host=out_rows is None or out_rows == seg.shape[0],

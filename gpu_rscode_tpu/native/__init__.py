@@ -15,8 +15,11 @@ import ctypes
 import os
 import subprocess
 import threading
+import time
 
 import numpy as np
+
+from ..obs import metrics as _obs_metrics
 
 _SRC = os.path.join(os.path.dirname(__file__), "rs_native.cpp")
 _SO = os.path.join(os.path.dirname(__file__), "librs_native.so")
@@ -27,6 +30,20 @@ _build_failed = False
 
 class NativeUnavailable(RuntimeError):
     pass
+
+
+def _count_io(direction: str, call: str, nbytes: int, seconds: float) -> None:
+    """rs_io_* accounting for one staging call (no-op unless RS_METRICS).
+    ``direction`` is "read" or "write"; ``call`` labels the staging
+    primitive so read and write balances stay attributable per path."""
+    _obs_metrics.counter(
+        f"rs_io_{direction}_bytes_total",
+        f"bytes {direction} by the staging-I/O layer",
+    ).labels(call=call).inc(nbytes)
+    _obs_metrics.counter(
+        f"rs_io_{direction}_seconds_total",
+        f"wall seconds in staging-I/O {direction} calls",
+    ).labels(call=call).inc(seconds)
 
 
 def _build() -> str:
@@ -147,6 +164,7 @@ def stripe_read(
     native library is unavailable (avoids re-mapping the file per segment).
     """
     dst = np.empty((k, cols), dtype=np.uint8)
+    t0 = time.perf_counter()
     try:
         lib = get_lib()
     except NativeUnavailable:
@@ -156,15 +174,25 @@ def stripe_read(
             else np.memmap(path, dtype=np.uint8, mode="r")
         )
         dst[:] = 0
-        for i in range(k):
+
+        def read_row(i: int) -> None:
             lo = i * chunk + off
             hi = min(lo + cols, (i + 1) * chunk, total_size)
             if lo < hi:
                 dst[i, : hi - lo] = src[lo:hi]
+
+        # Fan the per-chunk range copies across the shared reader pool
+        # (RS_IO_READERS) — each row touches a distinct slice of dst and a
+        # distinct source range, so the rows are independent.
+        from ..parallel.io_executor import run_rows
+
+        run_rows(k, read_row)
+        _count_io("read", "stripe_read", dst.nbytes, time.perf_counter() - t0)
         return dst
     got = lib.rs_stripe_read(path.encode(), dst, chunk, k, off, cols, total_size)
     if got < 0:
         raise OSError(f"rs_stripe_read failed for {path!r} (I/O error or truncated file)")
+    _count_io("read", "stripe_read", dst.nbytes, time.perf_counter() - t0)
     return dst
 
 
@@ -174,18 +202,23 @@ def scatter_write(files, arr: np.ndarray, off: int) -> None:
     arr = np.ascontiguousarray(arr, dtype=np.uint8)
     p, cols = arr.shape
     assert len(files) == p
+    t0 = time.perf_counter()
     try:
         lib = get_lib()
     except NativeUnavailable:
         for fp, row in zip(files, arr):
             fp.seek(off)
             fp.write(row.tobytes())
+        _count_io(
+            "write", "scatter_write", arr.nbytes, time.perf_counter() - t0
+        )
         return
     for fp in files:
         fp.flush()  # nothing buffered may straddle the raw pwrite below
     fds = (ctypes.c_int * p)(*[fp.fileno() for fp in files])
     if lib.rs_scatter_write(fds, arr, p, cols, off) != 0:
         raise OSError("rs_scatter_write failed (short write)")
+    _count_io("write", "scatter_write", arr.nbytes, time.perf_counter() - t0)
 
 
 def gather_rows(files, off: int, cols: int, fallback_maps=None) -> np.ndarray:
@@ -201,16 +234,26 @@ def gather_rows(files, off: int, cols: int, fallback_maps=None) -> np.ndarray:
     """
     k = len(files)
     dst = np.empty((k, cols), dtype=np.uint8)
+    t0 = time.perf_counter()
     try:
         lib = get_lib()
     except NativeUnavailable:
         maps = fallback_maps
         if maps is None:
             maps = [np.memmap(f.name, dtype=np.uint8, mode="r") for f in files]
-        for i in range(k):
+
+        def read_row(i: int) -> None:
             dst[i] = maps[i][off : off + cols]
+
+        # Distinct memmaps and distinct dst rows: fan across the shared
+        # reader pool (RS_IO_READERS), mirroring rs_native.cpp's run_rows.
+        from ..parallel.io_executor import run_rows
+
+        run_rows(k, read_row)
+        _count_io("read", "gather_rows", dst.nbytes, time.perf_counter() - t0)
         return dst
     fds = (ctypes.c_int * k)(*[f.fileno() for f in files])
     if lib.rs_gather_rows(fds, dst, k, off, cols) != 0:
         raise OSError("rs_gather_rows failed (short read)")
+    _count_io("read", "gather_rows", dst.nbytes, time.perf_counter() - t0)
     return dst

@@ -34,11 +34,28 @@ class AsyncWindow(Generic[T]):
     through ``consume(tag, future)`` (which should block on the future —
     e.g. ``np.asarray`` — and commit the result).  ``flush`` drains the rest
     in order.
+
+    With an ``executor`` (:class:`.io_executor.DrainExecutor`) the drain
+    becomes *write-behind*: instead of running ``consume`` on the dispatch
+    thread, the oldest pending (tag, future) is handed to the executor's
+    bounded writer queue and ``push`` returns immediately — the fifth
+    pipeline stage (write ∥ dispatch).  Backpressure then comes from the
+    executor's ``depth``; in-flight device futures are bounded by
+    ``window depth + executor depth + workers``.  A worker exception
+    re-raises at the next ``push``/``flush`` (via ``executor.submit``);
+    note ``flush`` only *hands off* the remaining pending futures — the
+    executor's own ``flush`` (its context exit) is the write barrier.
     """
 
-    def __init__(self, depth: int, consume: Callable[[Any, T], None]):
+    def __init__(
+        self,
+        depth: int,
+        consume: Callable[[Any, T], None],
+        executor=None,
+    ):
         self.depth = max(1, depth)
         self.consume = consume
+        self.executor = executor
         self._pending: list[tuple[Any, T]] = []
 
     def _report_depth(self) -> None:
@@ -49,16 +66,28 @@ class AsyncWindow(Generic[T]):
         ).set(n)
         _tracing.counter("pipeline_inflight", inflight=n)
 
+    def _drain_oldest(self) -> None:
+        tag, future = self._pending.pop(0)
+        if self.executor is not None:
+            # Device futures know their size; the byte count feeds the
+            # write_drain span args and per-lane accounting (docs/IO.md).
+            nbytes = getattr(future, "nbytes", 0) or 0
+            self.executor.submit(
+                lambda: self.consume(tag, future), nbytes=int(nbytes)
+            )
+        else:
+            self.consume(tag, future)
+
     def push(self, tag: Any, future: T) -> None:
         self._pending.append((tag, future))
         self._report_depth()
         while len(self._pending) > self.depth:
-            self.consume(*self._pending.pop(0))
+            self._drain_oldest()
             self._report_depth()
 
     def flush(self) -> None:
         while self._pending:
-            self.consume(*self._pending.pop(0))
+            self._drain_oldest()
             self._report_depth()
 
     def __enter__(self):
@@ -68,7 +97,17 @@ class AsyncWindow(Generic[T]):
         if exc_type is None:
             self.flush()
         else:
+            # Abort: drop the pending futures unconsumed — but leave the
+            # inflight gauge/counter track reset to zero, not frozen at its
+            # last nonzero sample (a stale gauge would read as a live
+            # pipeline long after the window died).
+            dropped = len(self._pending)
             self._pending.clear()
+            if dropped:
+                self._report_depth()
+                _tracing.instant(
+                    "pipeline_aborted", lane="dispatch", dropped=dropped
+                )
         return False
 
 
@@ -172,6 +211,7 @@ class SegmentPrefetcher:
         self._produce = produce
         self._q: queue.Queue = queue.Queue(maxsize=max(1, depth))
         self._stop = threading.Event()
+        self._started = False
         self._thread = threading.Thread(
             target=self._run, name="rs-segment-prefetch", daemon=True
         )
@@ -206,6 +246,13 @@ class SegmentPrefetcher:
         return self
 
     def __next__(self):
+        if not self._started:
+            # Outside the context manager the worker thread never started,
+            # so q.get() below would block forever — fail loudly instead.
+            raise RuntimeError(
+                "SegmentPrefetcher must be used as a context manager "
+                "(worker thread not started; iterate inside 'with')"
+            )
         tag, item = self._q.get()
         if tag is self._STOP:
             self._stop.set()  # idempotent; lets join() return fast
@@ -216,6 +263,7 @@ class SegmentPrefetcher:
 
     def __enter__(self):
         self._thread.start()
+        self._started = True
         return self
 
     def __exit__(self, exc_type, exc, tb):

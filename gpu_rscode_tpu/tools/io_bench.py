@@ -1,18 +1,33 @@
-"""Host staging-IO throughput: serial vs row-threaded native calls.
+"""Host staging-IO throughput + sync-vs-write-behind pipeline A/B.
 
-The r4 tmpfs phase split (stream_tmpfs_cpu_20260730T*) attributed the
-end-to-end stream bound to "single-core IO copies"; round 5 threaded the
-row-parallel native staging (rs_stripe_read / rs_gather_rows /
-rs_scatter_write fan rows across std::threads, rs_native.cpp run_rows) to
-test that attribution.  This tool measures each staging call serial
-(RS_NATIVE_IO_THREADS=1) vs threaded on a tmpfs file, so the verdict —
-does threading lift the copy bound on this host, or is the bound memory
-bandwidth — is a committed artifact rather than an assumption.  Each row
-records ``host_cores``: the pool is min(cap, host_cores, rows), so on a
-1-core host (this build VM) the "threads8" column clamps to serial and
-parity between the columns is expected, not a threading verdict.
+Two modes:
+
+* **Default** — serial vs row-threaded native staging calls.  The r4
+  tmpfs phase split (stream_tmpfs_cpu_20260730T*) attributed the
+  end-to-end stream bound to "single-core IO copies"; round 5 threaded the
+  row-parallel native staging (rs_stripe_read / rs_gather_rows /
+  rs_scatter_write fan rows across std::threads, rs_native.cpp run_rows) to
+  test that attribution.  This mode measures each staging call serial
+  (RS_NATIVE_IO_THREADS=1) vs threaded on a tmpfs file, so the verdict —
+  does threading lift the copy bound on this host, or is the bound memory
+  bandwidth — is a committed artifact rather than an assumption.  Each row
+  records ``host_cores``: the pool is min(cap, host_cores, rows), so on a
+  1-core host (this build VM) the "threads8" column clamps to serial and
+  parity between the columns is expected, not a threading verdict.
+
+* **--ab** — end-to-end encode/decode/fleet-repair with the drain run
+  synchronously on the dispatch thread (``RS_IO_WRITERS=0``) vs on the
+  write-behind lane (docs/IO.md), printing the per-stage wall
+  decomposition (read / compute / write seconds from the PhaseTimer) so
+  the "steady-state wall → max(read, compute, write)" claim is checkable
+  on both CPU and TPU captures rather than asserted.  The ``fleet_repair``
+  rows compare the sequential per-archive rebuild (writers=0) against the
+  interleaved fleet pipeline.  Works with or without the native library
+  (the A/B compares drain scheduling, not staging-call implementation).
 
 Usage: python -m gpu_rscode_tpu.tools.io_bench [--mb 1024] [--trials 3]
+       python -m gpu_rscode_tpu.tools.io_bench --ab [--mb 256] [--k 10]
+           [--n 14] [--writers 2] [--archives 4] [--trace PREFIX]
 """
 
 from __future__ import annotations
@@ -25,13 +40,224 @@ import tempfile
 import time
 
 
+def _phase_split(timer, op: str) -> tuple[float, float, float]:
+    """(read, compute, write) wall seconds of one file operation, summed
+    from the PhaseTimer's phase accumulators.  Read covers staging +
+    metadata/chunk opens, compute covers dispatch + the D2H block, write
+    covers every output-side (io) phase."""
+    acc = timer.acc
+    read = sum(
+        acc.get(p, 0.0)
+        for p in (
+            "stage segment (io)", "open chunks (io)", "read metadata (io)",
+            "scan chunks (io)", "verify checksums",
+        )
+    )
+    compute = sum(
+        acc.get(p, 0.0)
+        for p in (
+            f"{op} dispatch", f"{op} compute", "invert matrix",
+            "invert matrices (batched)", "rebuild matrix",
+        )
+    )
+    write = sum(
+        acc.get(p, 0.0)
+        for p in (
+            "write parity (io)", "write natives (io)", "write output (io)",
+            "write chunks (io)", "write metadata (io)",
+        )
+    )
+    return read, compute, write
+
+
+def _ab_row(op: str, mode: str, writers: int, wall: float, timer,
+            nbytes: int) -> dict:
+    read, compute, write = _phase_split(timer, op)
+    return {
+        "metric": "io_ab", "op": op, "mode": mode, "writers": writers,
+        "wall_s": round(wall, 4), "read_s": round(read, 4),
+        "compute_s": round(compute, 4), "write_s": round(write, 4),
+        "max_stage_s": round(max(read, compute, write), 4),
+        "gbps": round(nbytes / wall / 1e9, 3),
+    }
+
+
+def _damage(path: str, k: int, targets=(0,)) -> None:
+    from ..utils.fileformat import chunk_file_name
+
+    for t in targets:
+        os.unlink(chunk_file_name(path, t))
+
+
+def _fleet_targets(k: int, p: int) -> tuple:
+    """Damage pattern for the fleet A/B: up to 4 lost chunks (two native,
+    two parity when available) so the rebuild's write volume is a real
+    fraction of its read volume — the regime the write-behind overlap
+    targets — while staying within the p-loss recovery budget."""
+    losses = min(4, p)
+    native_losses = (losses + 1) // 2
+    return tuple(range(native_losses)) + tuple(
+        range(k, k + losses - native_losses)
+    )
+
+
+def _ab_main(args) -> int:
+    """Sync-drain vs write-behind A/B over real encode/decode/fleet runs."""
+    import numpy as np
+
+    from .. import api
+    from ..utils.timing import PhaseTimer
+    from .make_conf import make_conf
+
+    k, n = args.k, args.n
+    p = n - k
+    total = args.mb * 1024 * 1024
+    # Segment sizing for ~8 segments per chunk: with one segment there is
+    # no pipeline to overlap and the A/B measures nothing.
+    segment_bytes = max(1 << 20, total // 8)
+    modes = (("sync", 0), ("writebehind", args.writers))
+    rng = np.random.default_rng(0)
+    strategy = {"strategy": args.strategy} if args.strategy else {}
+
+    def compare(op: str, make_fn, nbytes: int, reset=None) -> None:
+        # Paired, interleaved best-of-trials: one run on this class of
+        # host is jitter-prone wall, and running all of one mode's trials
+        # before the other's would fold any systematic drift (allocator,
+        # page-cache, thermal) into the verdict.  ``make_fn(mode)`` builds
+        # the timed callable for one arm.
+        best: dict = {}
+        for _ in range(max(1, args.trials)):
+            for mode, writers in modes:
+                os.environ["RS_IO_WRITERS"] = str(writers)
+                if reset is not None:
+                    reset()
+                fn = make_fn(mode)
+                timer = PhaseTimer(enabled=True)
+                t0 = time.perf_counter()
+                fn(timer)
+                wall = time.perf_counter() - t0
+                if mode not in best or wall < best[mode][0]:
+                    best[mode] = (wall, timer)
+        for mode, writers in modes:
+            wall, timer = best[mode]
+            print(json.dumps(
+                _ab_row(op, mode, writers, wall, timer, nbytes)
+            ), flush=True)
+
+    with tempfile.TemporaryDirectory(dir=args.dir) as d:
+        path = os.path.join(d, "ab.bin")
+        with open(path, "wb") as fp:
+            left = total
+            while left > 0:
+                nb = min(left, 64 << 20)
+                fp.write(rng.integers(0, 256, nb, np.uint8).tobytes())
+                left -= nb
+
+        # Warm the plan cache (AOT compiles) and the page cache once so
+        # the first timed mode does not pay compile walls the second
+        # skips; every timed run below reuses the same executables.
+        os.environ["RS_IO_WRITERS"] = "0"
+        api.encode_file(path, k, p, segment_bytes=segment_bytes, **strategy)
+        conf = make_conf(n, k, path)
+        warm_out = os.path.join(d, "warm.out")
+        api.decode_file(
+            path, conf, warm_out, segment_bytes=segment_bytes, **strategy
+        )
+        os.unlink(warm_out)
+
+        def trace_kw(op: str, mode: str) -> dict:
+            return (
+                {"trace_path": f"{args.trace}-{op}-{mode}.json"}
+                if args.trace else {}
+            )
+
+        compare(
+            "encode",
+            lambda mode: lambda t: api.encode_file(
+                path, k, p, segment_bytes=segment_bytes, timer=t,
+                **strategy, **trace_kw("encode", mode)
+            ),
+            total,
+        )
+        out = os.path.join(d, "ab.out")
+        compare(
+            "decode",
+            lambda mode: lambda t: api.decode_file(
+                path, conf, out, segment_bytes=segment_bytes, timer=t,
+                **strategy, **trace_kw("decode", mode)
+            ),
+            total,
+        )
+        os.unlink(out)
+
+        # Fleet repair: sequential rebuild (writers=0) vs the interleaved
+        # fleet pipeline.  Same damage pattern per mode so the rebuild
+        # shapes (and therefore the cached plans) are identical.
+        fleet_mb = max(1, args.mb // max(1, args.archives))
+        fleet_bytes = fleet_mb * 1024 * 1024
+        fleet_seg = max(1 << 20, fleet_bytes // 8)
+        archives = []
+        for i in range(args.archives):
+            f = os.path.join(d, f"arch{i}.bin")
+            with open(f, "wb") as fp:
+                fp.write(rng.integers(0, 256, fleet_bytes, np.uint8).tobytes())
+            api.encode_file(f, k, p, segment_bytes=fleet_seg, **strategy)
+            archives.append(f)
+        # Warm the repair plan shapes (rebuild rows = len(targets)).
+        targets = _fleet_targets(k, p)
+        _damage(archives[0], k, targets=targets)
+        api.repair_file(archives[0], segment_bytes=fleet_seg, **strategy)
+
+        def redamage() -> None:
+            for f in archives:
+                _damage(f, k, targets=targets)
+
+        compare(
+            "fleet_repair",
+            lambda mode: lambda t: api.repair_fleet(
+                archives, segment_bytes=fleet_seg, timer=t,
+                **strategy, **trace_kw("fleet", mode)
+            ),
+            fleet_bytes * len(archives),
+            reset=redamage,
+        )
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--mb", type=int, default=1024, help="file size MB")
     ap.add_argument("--k", type=int, default=10)
     ap.add_argument("--trials", type=int, default=3)
     ap.add_argument("--dir", default="/dev/shm", help="work dir (tmpfs)")
+    ap.add_argument(
+        "--ab", action="store_true",
+        help="A/B sync-drain (RS_IO_WRITERS=0) vs write-behind pipelines "
+        "with per-stage read/compute/write wall decomposition",
+    )
+    ap.add_argument("--n", type=int, default=14, help="--ab: total chunks")
+    ap.add_argument(
+        "--writers", type=int, default=2,
+        help="--ab: RS_IO_WRITERS for the write-behind arm",
+    )
+    ap.add_argument(
+        "--archives", type=int, default=4,
+        help="--ab: damaged archives in the fleet_repair comparison",
+    )
+    ap.add_argument(
+        "--trace", default=None,
+        help="--ab: export Perfetto traces as PREFIX-<op>-<mode>.json",
+    )
+    ap.add_argument(
+        "--strategy", default=None,
+        help="--ab: GEMM strategy (e.g. cpu for the native host codec — "
+        "on CPU-only hosts the device emulation is so slow that compute "
+        "swamps the I/O the A/B measures; cpu makes the write phase a "
+        "real fraction of wall, the regime the overlap targets)",
+    )
     args = ap.parse_args()
+    if args.ab:
+        return _ab_main(args)
 
     import numpy as np
 

@@ -45,6 +45,9 @@ class PhaseTimer:
         self.acc: dict[str, float] = defaultdict(float)
         self.counts: dict[str, int] = defaultdict(int)
         self.best: dict[str, float] = {}  # per-phase minimum duration
+        # Phases land from three threads at once (dispatch, prefetch
+        # worker, write-behind drain); += on the dicts is read-modify-write.
+        self._rec_lock = threading.Lock()
         self._t0 = time.perf_counter()
 
     @classmethod
@@ -55,11 +58,12 @@ class PhaseTimer:
         return name[name.rfind("(") + 1 : -1] in cls.COMM_TAGS
 
     def _record(self, name: str, dt: float) -> None:
-        self.acc[name] += dt
-        self.counts[name] += 1
-        prev = self.best.get(name)
-        if prev is None or dt < prev:
-            self.best[name] = dt
+        with self._rec_lock:
+            self.acc[name] += dt
+            self.counts[name] += 1
+            prev = self.best.get(name)
+            if prev is None or dt < prev:
+                self.best[name] = dt
 
     @contextmanager
     def phase(self, name: str):

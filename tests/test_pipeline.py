@@ -190,6 +190,56 @@ def test_file_roundtrip_on_mesh(tmp_path, stripe):
     assert open(out, "rb").read() == data
 
 
+def test_sync_vs_writebehind_deterministic(tmp_path, monkeypatch):
+    """Tier-1 determinism guard for the write-behind drain (docs/IO.md):
+    the same encode+decode workload with RS_IO_WRITERS=0 (synchronous
+    inline drain) and =2 (write-behind lane) must produce byte-identical
+    outputs AND identical `rs stats` segment counts — the executor may
+    move work off the dispatch thread but must not change what is
+    dispatched or written."""
+    from gpu_rscode_tpu.obs import metrics as obs_metrics
+    from gpu_rscode_tpu.utils.fileformat import (
+        chunk_file_name,
+        metadata_file_name,
+    )
+
+    path = str(tmp_path / "f.bin")
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=250_001, dtype=np.uint8).tobytes()
+    open(path, "wb").write(data)
+
+    def segment_counts() -> dict:
+        snap = obs_metrics.REGISTRY.snapshot()
+        return snap.get("segments_dispatched", {}).get("values", {})
+
+    runs = {}
+    obs_metrics.force_enable()
+    try:
+        for writers in ("0", "2"):
+            monkeypatch.setenv("RS_IO_WRITERS", writers)
+            obs_metrics.REGISTRY.reset()
+            api.encode_file(
+                path, 4, 2, segment_bytes=32 * 1024, checksums=True
+            )
+            conf = make_conf(6, 4, path)
+            out = str(tmp_path / f"out{writers}")
+            api.decode_file(path, conf, out)
+            runs[writers] = {
+                "chunks": [
+                    open(chunk_file_name(path, i), "rb").read()
+                    for i in range(6)
+                ],
+                "meta": open(metadata_file_name(path), "rb").read(),
+                "out": open(out, "rb").read(),
+                "segments": segment_counts(),
+            }
+    finally:
+        obs_metrics.force_enable(False)
+        obs_metrics.REGISTRY.reset()
+    assert runs["0"]["out"] == data
+    assert runs["0"] == runs["2"]
+
+
 def test_mesh_output_identical_to_single(tmp_path):
     from gpu_rscode_tpu.utils.fileformat import chunk_file_name
 
