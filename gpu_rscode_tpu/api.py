@@ -22,14 +22,17 @@ and ``decode_file`` (decode.cu:235-434), redesigned for a TPU host runtime:
 from __future__ import annotations
 
 import functools
+import inspect
 import os
+import time
 
 import numpy as np
 
 from contextlib import contextmanager, nullcontext
 
 from .codec import RSCodec
-from .obs import metrics as _obs_metrics, tracing as _obs_tracing
+from .obs import metrics as _obs_metrics, runlog as _obs_runlog, \
+    tracing as _obs_tracing
 from .parallel.io_executor import DrainExecutor, FleetPipeline
 from .parallel.pipeline import AsyncWindow, DeviceStagingRing, SegmentPrefetcher
 from .utils.fileformat import (
@@ -86,15 +89,47 @@ def _observed_file_op(op: str):
     Perfetto JSON on completion, records a top-level span, and counts the
     operation in ``rs_file_ops_total`` (RS_METRICS).  Sessions are
     reentrant, so nested entry points (auto_decode -> decode, fleet ->
-    repair) record into ONE trace owned by the outermost call."""
+    repair) record into ONE trace owned by the outermost call.
+
+    With ``RS_RUNLOG`` set, every wrapped call — success OR failure —
+    also appends one structured record to the persistent run ledger
+    (obs/runlog.py): op, config {k,n,w,strategy}, input bytes, wall,
+    the PhaseTimer phase decomposition and the outcome.  Nested entry
+    points each get their own record (a fleet repair's per-archive
+    zero-size fallthroughs are real operations too)."""
 
     def deco(fn):
+        sig = inspect.signature(fn)
+
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             trace_path = kwargs.pop("trace_path", None)
-            with _obs_tracing.session(trace_path):
-                with _obs_tracing.span(op, lane="op"):
-                    out = fn(*args, **kwargs)
+            t0 = time.perf_counter()
+            # Entry snapshot of a caller-supplied timer: nested fleet ops
+            # share one, and the record must carry THIS op's delta, not
+            # the fleet's running totals.
+            phases0 = (
+                _obs_runlog.timer_phases(sig, args, kwargs)
+                if _obs_runlog.enabled() else None
+            )
+            error: BaseException | None = None
+            try:
+                with _obs_tracing.session(trace_path):
+                    with _obs_tracing.span(op, lane="op"):
+                        out = fn(*args, **kwargs)
+            except BaseException as e:
+                error = e
+                raise
+            finally:
+                # Failure records matter MOST (the regression watch and
+                # the error-rate trend both read them); recording itself
+                # never raises into the operation.
+                if _obs_runlog.enabled():
+                    _obs_runlog.record_file_op(
+                        op, sig, args, kwargs,
+                        wall=time.perf_counter() - t0, error=error,
+                        phases_before=phases0,
+                    )
             _obs_metrics.counter(
                 "rs_file_ops_total", "file-level operations completed"
             ).labels(op=op).inc()
@@ -1496,36 +1531,55 @@ class _ChunkScan:
 
 
 def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
-    """Discover chunk health next to ``in_file`` (size + CRC checks)."""
-    meta = metadata_file_name(in_file)
-    total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
-    _check_gfwidth(w, meta)
-    if total_mat is None:
-        total_mat = _regenerate_total_matrix(p, k, w)
-    if int(total_mat.max(initial=0)) >= (1 << w):
-        raise ValueError(
-            f"metadata matrix entry {int(total_mat.max())} out of range for "
-            f"GF(2^{w}) — corrupt or foreign .METADATA"
+    """Discover chunk health next to ``in_file`` (size + CRC checks).
+
+    The scrub instrumentation point: every archive scan counts itself
+    and its per-chunk verdicts (``rs_scrub_archives_scanned_total`` /
+    ``rs_scrub_chunks_total{state}``) and records one span on the
+    ``scrub`` lane, so fleet-wide health sweeps (scan_file, repair_fleet,
+    auto-decode discovery) all feed the same series.
+    """
+    with _obs_tracing.span("scan_chunks", lane="scrub", file=in_file):
+        meta = metadata_file_name(in_file)
+        total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
+        _check_gfwidth(w, meta)
+        if total_mat is None:
+            total_mat = _regenerate_total_matrix(p, k, w)
+        if int(total_mat.max(initial=0)) >= (1 << w):
+            raise ValueError(
+                f"metadata matrix entry {int(total_mat.max())} out of range "
+                f"for GF(2^{w}) — corrupt or foreign .METADATA"
+            )
+        chunk = chunk_size_for(total_size, k, w // 8)
+        chunk_states = _obs_metrics.counter(
+            "rs_scrub_chunks_total", "chunk verdicts from archive scans"
         )
-    chunk = chunk_size_for(total_size, k, w // 8)
-    healthy: list[int] = []
-    bad: dict[int, str] = {}
-    for i in range(k + p):
-        path = chunk_file_name(in_file, i)
-        if not os.path.exists(path):
-            continue
-        if os.path.getsize(path) < chunk:
-            bad[i] = path  # present but truncated — damage, not loss
-            continue
-        if i in crcs:
-            mm = _open_chunk(path, chunk)  # empty-safe for chunk == 0
-            if chunk_crc32(mm, chunk, segment_bytes) != crcs[i]:
-                bad[i] = path
+        healthy: list[int] = []
+        bad: dict[int, str] = {}
+        for i in range(k + p):
+            path = chunk_file_name(in_file, i)
+            if not os.path.exists(path):
+                chunk_states.labels(state="missing").inc()
                 continue
-        healthy.append(i)
-    return _ChunkScan(
-        in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy, bad
-    )
+            if os.path.getsize(path) < chunk:
+                bad[i] = path  # present but truncated — damage, not loss
+                chunk_states.labels(state="truncated").inc()
+                continue
+            if i in crcs:
+                mm = _open_chunk(path, chunk)  # empty-safe for chunk == 0
+                if chunk_crc32(mm, chunk, segment_bytes) != crcs[i]:
+                    bad[i] = path
+                    chunk_states.labels(state="crc_mismatch").inc()
+                    continue
+            healthy.append(i)
+            chunk_states.labels(state="healthy").inc()
+        _obs_metrics.counter(
+            "rs_scrub_archives_scanned_total", "archive health scans"
+        ).labels(outcome="damaged" if bad or len(healthy) < k + p
+                 else "clean").inc()
+        return _ChunkScan(
+            in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy, bad
+        )
 
 
 def _select_decodable_subset(scan: _ChunkScan):
@@ -1682,16 +1736,16 @@ def repair_file(
     """
     timer = timer or PhaseTimer(enabled=False)
     if len(_mesh_processes(mesh)) > 1:
-        return _repair_file_multiprocess(
+        return _count_repair_outcome(_repair_file_multiprocess(
             in_file, strategy=strategy, segment_bytes=segment_bytes,
             pipeline_depth=pipeline_depth, mesh=mesh,
             stripe_sharded=stripe_sharded, timer=timer,
-        )
+        ))
     with timer.phase("scan chunks (io)"):
         scan = _scan_chunks(in_file, segment_bytes)
     targets = scan.unhealthy
     if not targets:
-        return []
+        return _count_repair_outcome([])
     if scan.chunk == 0:
         # Zero-size foreign archive: every chunk is the empty file, so
         # "rebuild" is recreating empties — no survivors read, no GEMM.
@@ -1706,14 +1760,30 @@ def repair_file(
                 metadata_file_name(in_file),
                 {**scan.crcs, **{t: 0 for t in targets}},  # crc32(b"") == 0
             )
-        return targets
+        return _count_repair_outcome(targets)
     with timer.phase("invert matrix"):
         chosen, inv = _select_decodable_subset(scan)
-    return _repair_streamed(
+    return _count_repair_outcome(_repair_streamed(
         in_file, scan, chosen, inv, strategy=strategy,
         segment_bytes=segment_bytes, pipeline_depth=pipeline_depth,
         mesh=mesh, stripe_sharded=stripe_sharded, timer=timer,
-    )
+    ))
+
+
+def _count_repair_outcome(rebuilt: list[int]) -> list[int]:
+    """Count one archive's repair verdict (the scrub/repair loop's
+    outcome series): ``rs_repair_outcomes_total{outcome}`` plus the
+    rebuilt-chunk volume.  Identity on its argument so the return sites
+    stay one-liners."""
+    _obs_metrics.counter(
+        "rs_repair_outcomes_total", "archive repair outcomes"
+    ).labels(outcome="rebuilt" if rebuilt else "healthy").inc()
+    if rebuilt:
+        _obs_metrics.counter(
+            "rs_repair_chunks_rebuilt_total",
+            "chunk files regenerated by repair",
+        ).inc(len(rebuilt))
+    return rebuilt
 
 
 def _repair_streamed(
@@ -2164,6 +2234,9 @@ def repair_fleet(
                 except ValueError as e:
                     errors[f] = str(e)
     if errors:
+        _obs_metrics.counter(
+            "rs_repair_outcomes_total", "archive repair outcomes"
+        ).labels(outcome="unrecoverable").inc(len(errors))
         raise ValueError(
             "unrecoverable archives (nothing repaired): "
             + "; ".join(f"{f}: {msg}" for f, msg in sorted(errors.items()))
@@ -2176,7 +2249,7 @@ def repair_fleet(
         for f in files:
             s = scans[f]
             if not s.unhealthy:
-                results[f] = []
+                results[f] = _count_repair_outcome([])
             elif s.chunk == 0:
                 # Zero-size archives take repair_file's empty-rebuild
                 # path (no streamed writes to overlap).
@@ -2186,13 +2259,13 @@ def repair_fleet(
                 )
             else:
                 chosen, inv = chosen_inv[f]
-                results[f] = _repair_streamed(
+                results[f] = _count_repair_outcome(_repair_streamed(
                     f, s, chosen, inv, strategy=strategy,
                     segment_bytes=segment_bytes,
                     pipeline_depth=pipeline_depth,
                     mesh=None, stripe_sharded=False, timer=timer,
                     fleet=pipe,
-                )
+                ))
     return results
 
 
@@ -2217,6 +2290,9 @@ def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> di
         ok = "unknown"
     except ValueError:
         ok = False
+    _obs_metrics.counter(
+        "rs_scrub_verdicts_total", "scan_file decodability verdicts"
+    ).labels(decodable=str(ok)).inc()
     return {
         "k": scan.k,
         "p": scan.p,

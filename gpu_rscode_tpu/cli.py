@@ -64,15 +64,30 @@ Extensions: [--generator vandermonde|cauchy]
             inversions run in one batched device dispatch)
             [--scrub]  (with -i: read-only health report as one JSON line)
 Observability (docs/OBSERVABILITY.md):
-            [--metrics-json PATH] (encode/decode/repair: collect the
-            RS_METRICS registry during the run — enabled automatically —
-            and dump the unified snapshot, plan cache included, as JSON)
-            [--trace PATH] (encode/decode/repair: write a per-segment
-            Chrome-trace/Perfetto timeline; equivalent to RS_TRACE=PATH)
-Subcommand:  rs stats [--text] [--workload]
+            [--metrics-json PATH] (any operation, --scrub included:
+            collect the RS_METRICS registry during the run — enabled
+            automatically — and dump the unified snapshot, plan cache
+            included, as JSON; multi-process jobs write PATH.p<i> per
+            process, merged by `rs aggregate`)
+            [--trace PATH] (write a per-segment Chrome-trace/Perfetto
+            timeline; equivalent to RS_TRACE=PATH; PATH.p<i> per process
+            on multi-process jobs)
+            RS_RUNLOG=PATH appends one ledger record per operation;
+            RS_METRICS_PORT=P serves /metrics live during the run
+Subcommands: rs stats [--text] [--workload]
             (dump the unified observability snapshot of this process;
             --text = Prometheus exposition, --workload = run a synthetic
             multi-tail encode first)
+            rs history [--op OP] [--k K] [--n N] [--w W] [--strategy S]
+            [--last N] [--json] [--save-baseline NAME]
+            [--regress NAME [--threshold F] [--window N]]
+            (trend the RS_RUNLOG run ledger; --regress exits 3 when the
+            recent window's mean GB/s drops below the named baseline)
+            rs serve-metrics [--port P] [--addr A] [--runlog PATH]
+            (foreground HTTP endpoint: /metrics, /healthz, /runs)
+            rs aggregate INPUT... [--snapshot-out F] [--trace-out F] [--text]
+            (merge per-process {path}.p<i> snapshots/traces from a
+            multi-host run into one snapshot / one Perfetto file)
 """
 
 
@@ -120,6 +135,216 @@ def _stats_main(argv: list[str]) -> int:
     return 0
 
 
+def _history_main(argv: list[str]) -> int:
+    """The ``rs history`` subcommand: filter/trend the persistent run
+    ledger (obs/runlog.py) by op + config, with ``--regress`` comparing
+    the recent window against a named baseline (the measurement-driven
+    regression watch — exit 3 past the threshold, so a cron job or CI
+    step can gate on it)."""
+    import argparse
+    import json
+    import statistics
+    import time as _time
+
+    from .obs import runlog as obs_runlog
+
+    ap = argparse.ArgumentParser(
+        prog="rs history",
+        description="Trend the RS_RUNLOG run ledger (and capture_header-"
+        "style bench captures) by op + config; --regress gates on a "
+        "named throughput baseline.",
+    )
+    ap.add_argument("--runlog", default=None,
+                    help="ledger path (default: $RS_RUNLOG)")
+    ap.add_argument("--op", help="filter: op (or bench tool) name")
+    ap.add_argument("--k", type=int, help="filter: native chunk count")
+    ap.add_argument("--n", type=int, help="filter: total chunk count")
+    ap.add_argument("--w", type=int, help="filter: GF symbol width")
+    ap.add_argument("--strategy", help="filter: GEMM strategy")
+    ap.add_argument("--host", help="filter: origin hostname")
+    ap.add_argument("--last", type=int, default=0,
+                    help="list only the last N filtered records")
+    ap.add_argument("--json", action="store_true",
+                    help="emit filtered records as JSONL instead of text")
+    ap.add_argument("--window", type=int, default=20,
+                    help="records in the trend/baseline window (last N)")
+    ap.add_argument("--save-baseline", metavar="NAME",
+                    help="store the current window's throughput under NAME")
+    ap.add_argument("--regress", metavar="NAME",
+                    help="compare the current window against baseline NAME; "
+                    "exit 3 when mean GB/s drops past --threshold")
+    ap.add_argument("--threshold", type=float, default=0.25,
+                    help="--regress tolerance as a fraction (default 0.25 = "
+                    "fail when >25%% below the baseline mean)")
+    ap.add_argument("--baselines", default=None,
+                    help="baseline store (default: <runlog>.baselines.json)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    ledger = args.runlog or os.environ.get("RS_RUNLOG")
+    if not ledger:
+        print("rs history: no ledger — pass --runlog or set RS_RUNLOG",
+              file=sys.stderr)
+        return 2
+    if not (os.path.exists(ledger) or os.path.exists(ledger + ".1")):
+        print(f"rs history: ledger not found: {ledger}", file=sys.stderr)
+        return 1
+    recs = obs_runlog.filter_records(
+        obs_runlog.read_records(ledger),
+        op=args.op, k=args.k, n=args.n, w=args.w,
+        strategy=args.strategy, host=args.host,
+    )
+    shown = recs[-args.last:] if args.last else recs
+    window = recs[-args.window:] if args.window else recs
+    gbps = [g for g in map(obs_runlog.throughput_gbps, window)
+            if g is not None]
+    errors = sum(1 for r in recs if r.get("outcome") == "error")
+
+    if args.json:
+        for r in shown:
+            print(json.dumps(r))
+    elif not (args.save_baseline or args.regress):
+        for r in shown:
+            cfg = r.get("config") or {}
+            when = _time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", _time.gmtime(r.get("ts", 0))
+            )
+            g = obs_runlog.throughput_gbps(r)
+            print(
+                f"{when} {r.get('op') or r.get('tool') or '?':<13}"
+                f" k={cfg.get('k', '-')} n={cfg.get('n', '-')}"
+                f" w={cfg.get('w', '-')} {cfg.get('strategy', '-'):<9}"
+                f" {r.get('bytes') or 0:>12}B {r.get('wall_s') or 0:>9.3f}s"
+                f" {f'{g:.3f}GB/s' if g is not None else '-':>11}"
+                f" {r.get('outcome', '?')}"
+            )
+        print(
+            f"# {len(recs)} records ({errors} errors); window of "
+            f"{len(window)}: "
+            + (
+                f"mean {statistics.fmean(gbps):.3f} GB/s, "
+                f"best {max(gbps):.3f} GB/s over {len(gbps)} measured"
+                if gbps else "no throughput-measurable records"
+            ),
+            file=sys.stderr,
+        )
+
+    if not (args.save_baseline or args.regress):
+        return 0
+    if not gbps:
+        print("rs history: no successful records with bytes+wall in the "
+              "window — nothing to baseline or compare", file=sys.stderr)
+        return 1
+    mean = statistics.fmean(gbps)
+    store = args.baselines or ledger + ".baselines.json"
+    baselines: dict = {}
+    if os.path.exists(store):
+        try:
+            with open(store) as fp:
+                baselines = json.load(fp)
+        except (OSError, ValueError) as e:
+            print(f"rs history: unreadable baseline store {store}: {e}",
+                  file=sys.stderr)
+            return 1
+    if args.save_baseline:
+        baselines[args.save_baseline] = {
+            "gbps_mean": round(mean, 6),
+            "gbps_best": round(max(gbps), 6),
+            "count": len(gbps),
+            "saved_ts": _time.time(),
+            "filter": {
+                key: val for key, val in (
+                    ("op", args.op), ("k", args.k), ("n", args.n),
+                    ("w", args.w), ("strategy", args.strategy),
+                    ("host", args.host),
+                ) if val is not None
+            },
+        }
+        with open(store, "w") as fp:
+            json.dump(baselines, fp, indent=2)
+            fp.write("\n")
+        print(f"saved baseline {args.save_baseline!r}: mean {mean:.3f} GB/s "
+              f"over {len(gbps)} records -> {store}", file=sys.stderr)
+    if args.regress:
+        base = baselines.get(args.regress)
+        if base is None:
+            print(f"rs history: no baseline {args.regress!r} in {store} "
+                  f"(have: {sorted(baselines) or 'none'})", file=sys.stderr)
+            return 1
+        floor = base["gbps_mean"] * (1.0 - args.threshold)
+        verdict = (
+            f"window mean {mean:.3f} GB/s vs baseline "
+            f"{args.regress!r} {base['gbps_mean']:.3f} GB/s "
+            f"(floor {floor:.3f} at threshold {args.threshold:.0%})"
+        )
+        if mean < floor:
+            print(f"REGRESSION: {verdict}", file=sys.stderr)
+            return 3
+        print(f"ok: {verdict}", file=sys.stderr)
+    return 0
+
+
+def _serve_main(argv: list[str]) -> int:
+    """The ``rs serve-metrics`` subcommand: a foreground telemetry
+    endpoint (/metrics, /healthz, /runs) for this process — see
+    obs/serve.py.  ``RS_METRICS_PORT`` on a normal file operation starts
+    the same server for just that run's duration."""
+    import argparse
+
+    from .obs import metrics as obs_metrics, serve as obs_serve
+
+    ap = argparse.ArgumentParser(
+        prog="rs serve-metrics",
+        description="Serve /metrics (Prometheus text), /healthz and /runs "
+        "(run-ledger tail) over HTTP.",
+    )
+    ap.add_argument("--port", type=int, default=None,
+                    help="bind port (default $RS_METRICS_PORT or 9464)")
+    ap.add_argument("--addr", default=None,
+                    help="bind address (default $RS_METRICS_ADDR or 0.0.0.0)")
+    ap.add_argument("--runlog", default=None,
+                    help="ledger served at /runs (default: $RS_RUNLOG)")
+    ap.add_argument("--workload", action="store_true",
+                    help="run the synthetic encode workload first so a "
+                    "fresh process has series to scrape")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    if args.port is None:
+        try:
+            args.port = int(os.environ.get("RS_METRICS_PORT", "9464"))
+        except ValueError:
+            print(
+                f"rs serve-metrics: RS_METRICS_PORT="
+                f"{os.environ['RS_METRICS_PORT']!r} is not a port",
+                file=sys.stderr,
+            )
+            return 2
+    obs_metrics.force_enable()
+    if args.workload:
+        from .tools.plan_stats import run_workload
+
+        run_workload()
+    try:
+        server = obs_serve.make_server(args.port, args.runlog, args.addr)
+    except OSError as e:
+        print(f"rs serve-metrics: cannot bind: {e}", file=sys.stderr)
+        return 1
+    host, port = server.server_address[:2]
+    print(f"serving /metrics /healthz /runs on http://{host}:{port}",
+          file=sys.stderr)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return 0
+
+
 def _fail(msg: str) -> "int":
     print(msg, file=sys.stderr)
     print(_USAGE, file=sys.stderr)
@@ -130,6 +355,14 @@ def main(argv: list[str] | None = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     if argv and argv[0] == "stats":
         return _stats_main(argv[1:])
+    if argv and argv[0] == "history":
+        return _history_main(argv[1:])
+    if argv and argv[0] == "serve-metrics":
+        return _serve_main(argv[1:])
+    if argv and argv[0] == "aggregate":
+        from .obs.aggregate import main as _aggregate_main
+
+        return _aggregate_main(argv[1:])
     try:
         # gnu_getopt: flags may follow the fleet-repair positional archives
         # (the reference surface has no positionals, so ordering semantics
@@ -282,11 +515,6 @@ def main(argv: list[str] | None = None) -> int:
         return _fail("rs: --auto is decode-only")
     if auto and conf_file:
         return _fail("rs: -c and --auto conflict; pick one survivor source")
-    if op == "scrub" and (metrics_json or trace_path):
-        return _fail(
-            "rs: --metrics-json/--trace apply to encode/decode/repair "
-            "(scrub is a host-only CRC pass)"
-        )
     if stripe > 1 and not n_devices:
         return _fail("rs: --stripe requires --devices")
     if extra and op in ("encode", "decode"):
@@ -298,6 +526,33 @@ def main(argv: list[str] | None = None) -> int:
                 "rs: batch --auto decode does not take -o "
                 "(outputs are written in place, one per archive)"
             )
+
+    if n_devices and (metrics_json or trace_path):
+        # Multi-process jobs (JAX_NUM_PROCESSES workers running this same
+        # CLI with --devices): each process dumps its own telemetry part —
+        # {path}.p{i}, merged by obs/aggregate.py — resolved from the env
+        # HERE so the writability probe below exercises the real part
+        # path.  Gated on --devices: only that flag makes this run join
+        # the distributed job, so a stale JAX_NUM_PROCESSES in the shell
+        # must not redirect a single-process run's dump.  (The
+        # RS_DISTRIBUTED=auto detection path cannot know its index before
+        # the slow jax init; explicit-env jobs, the tested surface, can.)
+        from .obs.aggregate import part_path
+
+        try:
+            nproc = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+            pidx = int(os.environ.get("JAX_PROCESS_ID", "0"))
+        except ValueError:
+            nproc, pidx = 1, 0
+        if metrics_json:
+            metrics_json = part_path(metrics_json, pidx, nproc)
+        if trace_path:
+            trace_path = part_path(trace_path, pidx, nproc)
+        elif os.environ.get("RS_TRACE") and nproc > 1:
+            # The env spelling must suffix like the flag: otherwise every
+            # process of the job exports through the SAME file (and the
+            # same .rs_tmp), last-writer-wins clobbering the others.
+            trace_path = part_path(os.environ["RS_TRACE"], pidx, nproc)
 
     if metrics_json:
         # Fail fast on an unwritable snapshot path — AFTER every pure
@@ -362,6 +617,13 @@ def main(argv: list[str] | None = None) -> int:
         except OSError as e:  # writability probed up front; disk-full etc.
             print(f"rs: metrics snapshot write failed: {e}", file=sys.stderr)
 
+    # Live exposition for the run's duration: RS_METRICS_PORT starts the
+    # /metrics endpoint (obs/serve.py) on a daemon thread so a scraper can
+    # watch a long fleet job between launch and final snapshot.
+    from .obs import serve as obs_serve
+
+    obs_serve.maybe_start_from_env()
+
     timer = PhaseTimer(enabled=True)
     ctx = None
     if profile_dir:
@@ -413,6 +675,10 @@ def main(argv: list[str] | None = None) -> int:
                     if "segment_bytes" in kwargs
                     else {}
                 ),
+                # Scrub rides the same observability surfaces as the data
+                # ops: --trace exports the scan spans, and the snapshot
+                # dump in the finally below carries the scrub counters.
+                **({"trace_path": trace_path} if trace_path else {}),
             )
             print(json.dumps(report))
             # "unknown" (subset search capped) is not proven healthy -> 1.

@@ -8,9 +8,20 @@ One instrumentation surface for the whole codebase (docs/OBSERVABILITY.md):
 - :mod:`.tracing` — per-segment span tracer exporting Chrome-trace /
   Perfetto JSON.  Off by default; ``RS_TRACE=<path>`` (or a
   ``trace_path=`` argument on the file APIs) turns it on.
+- :mod:`.runlog` — persistent run ledger: one structured JSONL record
+  per file-level operation, appended to ``RS_RUNLOG`` with size-capped
+  rotation.  Off by default; ``rs history`` trends it.
+- :mod:`.aggregate` — multi-host merge: fuse per-process ``{path}.p<i>``
+  metric snapshots (counters sum, gauges max, histograms bucket-wise)
+  and Chrome traces (one Perfetto process lane per host) into one view.
+- :mod:`.serve` — stdlib HTTP exposition: ``/metrics`` (Prometheus
+  text), ``/healthz``, ``/runs`` (ledger tail); ``RS_METRICS_PORT`` or
+  ``rs serve-metrics`` starts it.
 
-Both modules are stdlib-only imports (no jax/numpy) so any layer can be
-instrumented without import-cost or backend-init concerns.
+All modules are stdlib-only imports (no jax/numpy) so any layer can be
+instrumented without import-cost or backend-init concerns
+(:mod:`.aggregate` and :mod:`.serve` load on demand — they serve the
+fleet side, not the hot path).
 """
 
-from . import metrics, tracing  # noqa: F401 (the public surface)
+from . import metrics, runlog, tracing  # noqa: F401 (the public surface)

@@ -328,28 +328,15 @@ class Registry:
         return out
 
     def render_text(self) -> str:
-        """Prometheus text exposition (scrape-format) of the registry."""
-        lines = []
-        with self._lock:
-            metrics = [self._metrics[n] for n in sorted(self._metrics)]
-        for m in metrics:
-            if m.help:
-                lines.append(f"# HELP {m.name} {m.help}")
-            lines.append(f"# TYPE {m.name} {m.kind}")
-            for key, child in sorted(m.series().items()):
-                ls = _label_str(key)
-                if isinstance(child, _HistogramChild):
-                    for le, cum in child.cumulative().items():
-                        sep = "," if key else ""
-                        inner = ls[1:-1] if key else ""
-                        lines.append(
-                            f'{m.name}_bucket{{{inner}{sep}le="{le}"}} {cum}'
-                        )
-                    lines.append(f"{m.name}_sum{ls} {child.sum}")
-                    lines.append(f"{m.name}_count{ls} {child.count}")
-                else:
-                    lines.append(f"{m.name}{ls} {child.value}")
-        return "\n".join(lines) + ("\n" if lines else "")
+        """Prometheus text exposition (scrape-format) of the registry.
+
+        Delegates to the snapshot-based renderer in :mod:`.aggregate` —
+        ONE copy of the exposition format serves the live registry, the
+        ``/metrics`` endpoint and merged multi-host snapshots alike.
+        """
+        from .aggregate import render_text
+
+        return render_text(self.snapshot())
 
 
 REGISTRY = Registry()

@@ -1,0 +1,420 @@
+"""Persistent run ledger — the cross-run half of the observability stack.
+
+The metrics registry and the span tracer (PR 2) answer "where did THIS
+run's time go"; nothing answered "is this host getting slower" — the perf
+trajectory lived in ~100 ad-hoc, schema-less files under ``bench_captures/``.
+This module gives every file-level operation a durable, structured record:
+
+* **One JSONL record per op** — run id, git sha, host, backend, op,
+  ``{k, n, w, strategy}`` config, input bytes, wall seconds, the
+  :class:`~..utils.timing.PhaseTimer` per-phase decomposition, outcome
+  (``ok`` / ``error`` + exception class) and a digest of the metrics
+  snapshot at completion — appended to the path named by ``RS_RUNLOG``.
+* **Crash-safe append** — each record is serialized to one full line and
+  written with a single ``O_APPEND`` write syscall, so concurrent
+  processes (fleet workers on a shared filesystem) interleave whole lines
+  and a crashed writer never leaves a torn record.  Readers skip
+  unparseable lines rather than failing the whole ledger.
+* **Size-capped rotation** — when the ledger exceeds
+  ``RS_RUNLOG_MAX_BYTES`` (default 8 MiB) it is renamed to ``<path>.1``
+  (one generation kept) before the append; :func:`read_records` folds the
+  rotated generation back in.
+* **Off by default** — like the rest of ``obs/``: no ``RS_RUNLOG``, no
+  file, and the enabled check is one env read.  Recording never raises:
+  a full disk or a bad path warns and drops the record — the ledger is
+  observability, it must not fail the operation it observes.
+
+The same identity header (:func:`capture_header`) goes at the top of every
+``tools/*_bench.py`` JSONL capture, so ``bench_captures/`` and the ledger
+share a vocabulary and ``rs history`` can trend either.
+
+Import cost: stdlib only (no jax, no numpy).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import uuid
+import warnings
+
+SCHEMA_VERSION = 1
+
+# 8 MiB default cap: ~20k records of typical size, months of fleet history.
+_DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+
+# One run id per process: every record (and every capture header) of one
+# invocation shares it, so multi-op runs (fleet repair, batch encode) group.
+_RUN_ID = uuid.uuid4().hex[:12]
+
+_GIT_SHA: str | None | bool = False  # False = not yet resolved
+
+
+def run_id() -> str:
+    """This process's run id (12 hex chars, stable for the process)."""
+    return _RUN_ID
+
+
+def path() -> str | None:
+    """The ledger path, or None when the ledger is disabled."""
+    return os.environ.get("RS_RUNLOG") or None
+
+
+def enabled() -> bool:
+    return path() is not None
+
+
+def git_sha() -> str | None:
+    """Short git sha of the source tree, resolved once per process.
+
+    ``RS_GIT_SHA`` overrides (containers without a .git); otherwise one
+    ``git rev-parse`` against the package's own directory; None when
+    neither works (an installed wheel).
+    """
+    global _GIT_SHA
+    if _GIT_SHA is not False:
+        return _GIT_SHA
+    sha = os.environ.get("RS_GIT_SHA")
+    if not sha:
+        try:
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=5,
+            ).stdout.strip() or None
+        except (OSError, subprocess.SubprocessError):
+            sha = None
+    _GIT_SHA = sha
+    return sha
+
+
+def backend_name() -> str:
+    """The jax backend serving this process, without forcing a jax import
+    (the ledger must stay recordable from jax-free contexts like the
+    native staging bench)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return "none"
+    try:
+        return jax.default_backend()
+    except Exception:  # backend init failed mid-run; record, don't raise
+        return "unknown"
+
+
+def process_index() -> int:
+    """This process's index in a multi-process job (0 single-process).
+
+    Reads the env var rather than ``jax.process_index()`` so the ledger
+    works before (or without) distributed init.
+    """
+    try:
+        return int(os.environ.get("JAX_PROCESS_ID", "0"))
+    except ValueError:
+        return 0
+
+
+def capture_header(tool: str) -> dict:
+    """The shared identity header for bench-capture JSONL files.
+
+    Every ``tools/*_bench.py`` writer prints this as its FIRST line, so a
+    capture file is self-describing (which host, which sha, which backend
+    produced these rows) and ``rs history`` can ingest ``bench_captures/``
+    with the same reader as the run ledger.
+    """
+    return {
+        "kind": "capture_header",
+        "schema": SCHEMA_VERSION,
+        "tool": tool,
+        "run": run_id(),
+        "ts": time.time(),
+        "git_sha": git_sha(),
+        "host": socket.gethostname(),
+        "backend": backend_name(),
+    }
+
+
+def metrics_digest() -> str | None:
+    """Short digest of the current metrics-registry snapshot — ties a
+    ledger record to the exact counter state it completed with (two
+    records with equal digests saw identical registries)."""
+    from . import metrics as _metrics
+
+    if not _metrics.enabled():
+        return None
+    snap = json.dumps(_metrics.REGISTRY.snapshot(), sort_keys=True)
+    return hashlib.sha256(snap.encode()).hexdigest()[:12]
+
+
+def _rotate(p: str, max_bytes: int) -> None:
+    try:
+        if os.path.getsize(p) < max_bytes:
+            return
+    except OSError:
+        return  # no ledger yet
+    try:
+        os.replace(p, p + ".1")
+    except OSError as e:
+        warnings.warn(f"runlog rotation of {p!r} failed: {e}", stacklevel=3)
+
+
+def append(record: dict, ledger_path: str | None = None) -> None:
+    """Append one record to the ledger (no-op when disabled).
+
+    Serializes to one line FIRST, then appends it with a single
+    ``O_APPEND`` write: concurrent fleet workers interleave whole lines,
+    and a crash mid-run can only lose the in-flight record, never tear
+    the file.  Errors warn and drop — never raise into the observed op.
+    """
+    p = ledger_path or path()
+    if not p:
+        return
+    try:
+        max_bytes = int(os.environ.get("RS_RUNLOG_MAX_BYTES",
+                                       _DEFAULT_MAX_BYTES))
+    except ValueError:
+        max_bytes = _DEFAULT_MAX_BYTES
+    _rotate(p, max_bytes)
+    # default=str: config values are caller-supplied (numpy ints etc.) —
+    # degrade to strings rather than lose the record.
+    line = json.dumps(record, default=str) + "\n"
+    try:
+        # O_RDWR (not O_WRONLY): the torn-tail probe pread below needs
+        # read permission on the same fd; O_APPEND keeps writes atomic.
+        fd = os.open(p, os.O_RDWR | os.O_CREAT | os.O_APPEND, 0o644)
+        try:
+            # Heal a torn tail: a writer that died mid-line left the file
+            # without a trailing newline; gluing this record onto that
+            # fragment would corrupt BOTH.  A leading newline isolates the
+            # fragment (readers skip it) — still one atomic write.
+            size = os.fstat(fd).st_size
+            if size and os.pread(fd, 1, size - 1) != b"\n":
+                line = "\n" + line
+            os.write(fd, line.encode())
+        finally:
+            os.close(fd)
+    except OSError as e:
+        warnings.warn(f"runlog append to {p!r} failed: {e}", stacklevel=2)
+
+
+def timer_phases(sig, args: tuple, kwargs: dict) -> dict | None:
+    """Best-effort snapshot of the bound ``timer`` argument's phase
+    accumulators — taken at operation ENTRY so the record can carry the
+    delta: nested fleet ops share one timer, and embedding its cumulative
+    totals would inflate every record after the first."""
+    try:
+        if sig is None:
+            return None
+        timer = sig.bind_partial(*args, **kwargs).arguments.get("timer")
+        if timer is not None and getattr(timer, "enabled", False):
+            return timer.phase_report()
+    except Exception:
+        pass
+    return None
+
+
+def record_file_op(
+    op: str,
+    sig,
+    args: tuple,
+    kwargs: dict,
+    *,
+    wall: float,
+    error: BaseException | None,
+    phases_before: dict | None = None,
+) -> None:
+    """Build and append the ledger record for one file-level operation.
+
+    Called from ``api._observed_file_op`` with the wrapped function's
+    signature so config fields are extracted by parameter NAME (the entry
+    points disagree about positional order).  Everything here is
+    best-effort: a field that cannot be extracted is omitted, never
+    raises.
+    """
+    try:
+        bound = {}
+        if sig is not None:
+            try:
+                ba = sig.bind_partial(*args, **kwargs)
+                ba.apply_defaults()  # strategy/w defaults are real config
+                bound = ba.arguments
+            except TypeError:
+                pass  # caller's own TypeError is already propagating
+
+        files: list[str] = []
+        primary = bound.get("file_name") or bound.get("in_file")
+        if isinstance(primary, str):
+            files = [primary]
+        elif bound.get("files") is not None:
+            files = [f for f in bound["files"] if isinstance(f, str)]
+
+        config: dict = {}
+        k = bound.get("native_num")
+        if k is not None:
+            config["k"] = int(k)
+            p_num = bound.get("parity_num")
+            if p_num is not None:
+                config["n"] = int(k) + int(p_num)
+        if bound.get("w") is not None:
+            config["w"] = int(bound["w"])
+        if bound.get("strategy") is not None:
+            config["strategy"] = str(bound["strategy"])
+        if bound.get("mesh") is not None:
+            config["mesh"] = True
+
+        nbytes = 0
+        for f in files:
+            try:
+                nbytes += os.path.getsize(f)
+            except OSError:
+                pass  # decode/repair inputs are chunk sets, not the file
+
+        phases = None
+        timer = bound.get("timer")
+        if timer is not None and getattr(timer, "enabled", False):
+            phases = timer.phase_report()
+            if phases_before:
+                # THIS op's share of a shared (fleet) timer: the delta
+                # since entry, dropping phases it never touched.
+                phases = {
+                    k: round(v - phases_before.get(k, 0.0), 6)
+                    for k, v in phases.items()
+                    if v - phases_before.get(k, 0.0) > 0
+                }
+
+        record({
+            "op": op,
+            "files": len(files),
+            "file": files[0] if files else None,
+            "config": config,
+            "bytes": nbytes,
+            "wall_s": round(wall, 6),
+            "phases": phases,
+            "outcome": "error" if error is not None else "ok",
+            "error": type(error).__name__ if error is not None else None,
+        })
+    except Exception as e:  # the ledger must never fail the operation
+        warnings.warn(f"runlog record for {op!r} failed: "
+                      f"{type(e).__name__}: {e}", stacklevel=2)
+
+
+def record(fields: dict, ledger_path: str | None = None) -> None:
+    """Append a record, filling the shared identity envelope (kind, run,
+    ts, git sha, host, process index, backend, metrics digest)."""
+    rec = {
+        "kind": "rs_run",
+        "schema": SCHEMA_VERSION,
+        "run": run_id(),
+        "ts": time.time(),
+        "git_sha": git_sha(),
+        "host": socket.gethostname(),
+        "proc": process_index(),
+        "backend": backend_name(),
+    }
+    rec.update(fields)
+    try:
+        rec["metrics_digest"] = metrics_digest()
+    except Exception:
+        rec["metrics_digest"] = None
+    append(rec, ledger_path)
+
+
+def read_records(p: str, include_rotated: bool = True) -> list[dict]:
+    """Read ledger (or bench-capture) records from ``p``, oldest first.
+
+    Includes the rotated ``<path>.1`` generation before the live file.
+    Unparseable or non-dict lines are skipped (a torn line from a crashed
+    writer must not hide the rest of the history).
+    """
+    out: list[dict] = []
+    paths = ([p + ".1"] if include_rotated else []) + [p]
+    for part in paths:
+        try:
+            with open(part) as fp:
+                for line in fp:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict):
+                        out.append(rec)
+        except OSError:
+            continue
+    return out
+
+
+def tail(p: str, n: int = 50) -> list[dict]:
+    """The last ``n`` records (the ``/runs`` endpoint's payload)."""
+    return read_records(p)[-n:]
+
+
+# -- history / trend helpers (the `rs history` subcommand's core) ------------
+
+
+def filter_records(
+    records: list[dict],
+    *,
+    op: str | None = None,
+    k: int | None = None,
+    n: int | None = None,
+    w: int | None = None,
+    strategy: str | None = None,
+    host: str | None = None,
+) -> list[dict]:
+    """Select ledger (or bench-capture) records by op + config.
+
+    ``op`` matches a record's ``op``, its ``tool``, or the tool named by
+    the most recent ``capture_header`` above it (bench tools stamp the
+    header once, not every row — so ``rs history --op io_bench`` trends a
+    raw capture file); config filters compare against the record's
+    ``config`` dict and skip records that lack the field only when the
+    filter asks for it.  Capture headers themselves are dropped — they
+    are identity, not measurements.
+    """
+    out = []
+    header_tool = None
+    for r in records:
+        if r.get("kind") == "capture_header":
+            header_tool = r.get("tool")
+            continue
+        cfg = r.get("config") or {}
+        if op is not None and op not in (
+            r.get("op"), r.get("tool", header_tool)
+        ):
+            continue
+        if k is not None and cfg.get("k") != k:
+            continue
+        if n is not None and cfg.get("n") != n:
+            continue
+        if w is not None and cfg.get("w") != w:
+            continue
+        if strategy is not None and cfg.get("strategy") != strategy:
+            continue
+        if host is not None and r.get("host") != host:
+            continue
+        out.append(r)
+    return out
+
+
+def throughput_gbps(rec: dict) -> float | None:
+    """End-to-end GB/s of one successful record; None when the record
+    failed or lacks both the bytes/wall pair and a precomputed ``gbps``
+    field (bench rows like io_bench's ``io_ab`` report gbps directly)."""
+    if rec.get("outcome", "ok") != "ok":
+        return None
+    nbytes, wall = rec.get("bytes"), rec.get("wall_s")
+    if isinstance(nbytes, (int, float)) and isinstance(
+        wall, (int, float)
+    ) and nbytes > 0 and wall > 0:
+        return nbytes / wall / 1e9
+    g = rec.get("gbps")
+    if isinstance(g, (int, float)) and g > 0:
+        return float(g)
+    return None

@@ -29,12 +29,32 @@ from __future__ import annotations
 import functools
 import json
 import os
+import socket
 import threading
 import time
 from collections import deque
 from contextlib import contextmanager, nullcontext
 
 _NULL_CM = nullcontext()
+
+# Multi-host alignment state (obs/aggregate.py): the shared epoch is the
+# wall clock captured right after jax.distributed.initialize returns — a
+# barrier every process crosses near-simultaneously — so per-process trace
+# timelines can be fused onto one time axis.  Set via mark_epoch()
+# (parallel/distributed.py calls it); stays None in single-process runs.
+_EPOCH: float | None = None
+_PROCESS_INDEX: int | None = None
+
+
+def mark_epoch(process_index: int | None = None,
+               epoch: float | None = None) -> None:
+    """Record the shared alignment epoch (and this process's index) that
+    every subsequent trace export embeds in ``otherData`` — called once,
+    right after distributed init, when all processes are in lockstep."""
+    global _EPOCH, _PROCESS_INDEX
+    _EPOCH = time.time() if epoch is None else epoch
+    if process_index is not None:
+        _PROCESS_INDEX = int(process_index)
 
 
 class Tracer:
@@ -49,6 +69,9 @@ class Tracer:
         self.path = path
         self._events: deque = deque()
         self._t0 = time.perf_counter()
+        # Wall clock at t0: lets the aggregator place this trace's
+        # relative timestamps on a shared cross-host axis.
+        self.wall_t0 = time.time()
         self._lanes: dict[str, int] = {}
         self._lock = threading.Lock()
 
@@ -150,9 +173,17 @@ class Tracer:
             "pid": 1,
             "args": {"name": "gpu_rscode_tpu"},
         }]
+        # otherData rides the standard Chrome-trace envelope (ignored by
+        # viewers): identity + alignment anchors for obs/aggregate.py.
+        other = {"rs_wall_t0": self.wall_t0, "rs_host": socket.gethostname()}
+        if _EPOCH is not None:
+            other["rs_epoch"] = _EPOCH
+        if _PROCESS_INDEX is not None:
+            other["rs_process_index"] = _PROCESS_INDEX
         payload = {
             "traceEvents": meta + self.events(),
             "displayTimeUnit": "ms",
+            "otherData": other,
         }
         tmp = path + ".rs_tmp"
         try:

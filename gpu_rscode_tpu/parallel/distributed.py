@@ -36,6 +36,7 @@ def initialize(
     import jax
 
     if jax.distributed.is_initialized():
+        _mark_telemetry_epoch(jax)
         return  # idempotent: callers (library AND cli) may both invoke this
 
     coordinator_address = coordinator_address or os.environ.get(
@@ -47,6 +48,7 @@ def initialize(
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if auto or os.environ.get("RS_DISTRIBUTED") == "auto":
         jax.distributed.initialize()
+        _mark_telemetry_epoch(jax)
         return
     if coordinator_address is None and num_processes is None and process_id is None:
         return  # single process, nothing configured
@@ -55,6 +57,21 @@ def initialize(
         num_processes=num_processes,
         process_id=process_id,
     )
+    _mark_telemetry_epoch(jax)
+
+
+def _mark_telemetry_epoch(jax) -> None:
+    """Capture the shared trace-alignment epoch (obs/aggregate.py).
+
+    ``jax.distributed.initialize`` is a barrier every process crosses
+    near-simultaneously, so the wall clock HERE is the common time anchor
+    that lets per-process Perfetto traces fuse onto one axis.  Marked only
+    once (re-init calls keep the first, earliest anchor).
+    """
+    from ..obs import tracing
+
+    if tracing._EPOCH is None:
+        tracing.mark_epoch(process_index=jax.process_index())
 
 
 def global_mesh(stripe: int = 1):

@@ -156,6 +156,13 @@ def put_sharded(B, mesh, stripe_sharded: bool = False):
         "rs_mesh_segments_staged_total",
         "segments placed onto a device mesh (put_sharded)",
     ).labels(stripe=stripe_sharded, procs=jax.process_count()).inc()
+    # Byte volume alongside the segment count: per-process in a multi-host
+    # job (each host stages only its local portion), so the aggregate sum
+    # (obs/aggregate.py) is the fleet's true staged-traffic total.
+    _metrics.counter(
+        "rs_mesh_staged_bytes_total",
+        "bytes placed onto a device mesh (process-local portion)",
+    ).labels(stripe=stripe_sharded).inc(int(B.nbytes))
     with _tracing.span(
         "mesh_stage", lane="stage", cols=int(B.shape[1]),
         stripe=bool(stripe_sharded),

@@ -74,8 +74,11 @@ def main() -> int:
     from ._bench_timing import time_device_fn
 
     import jax
-
     import jax.numpy as jnp
+
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("expand_probe")), flush=True)
 
     label = backend_label()
     k, p = args.k, args.p

@@ -46,6 +46,10 @@ def main() -> int:
 
     import jax
 
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("inverse_bench")), flush=True)
+
     label = backend_label()
     print(f"# backend={label}", file=sys.stderr, flush=True)
     gf = get_field(8)

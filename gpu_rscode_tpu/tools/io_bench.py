@@ -106,8 +106,13 @@ def _ab_main(args) -> int:
     import numpy as np
 
     from .. import api
+    from ..obs.runlog import capture_header
     from ..utils.timing import PhaseTimer
     from .make_conf import make_conf
+
+    # Header AFTER the api import: the A/B runs on a live backend and the
+    # capture identity must record which one.
+    print(json.dumps(capture_header("io_bench")), flush=True)
 
     k, n = args.k, args.n
     p = n - k
@@ -258,6 +263,15 @@ def main() -> int:
     args = ap.parse_args()
     if args.ab:
         return _ab_main(args)
+
+    # The shared capture identity header (obs/runlog.py): first line of
+    # every capture, so bench_captures/ files are self-describing and
+    # `rs history` can ingest them.  The default (native staging) mode
+    # never imports jax, so the header truthfully records backend
+    # "none" — no device was involved in these rows.
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("io_bench")), flush=True)
 
     import numpy as np
 

@@ -36,6 +36,10 @@ def main() -> int:
     import jax
     import jax.numpy as jnp
 
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("k_sweep")), flush=True)
+
     from .. import native
     from ..models.vandermonde import vandermonde_matrix
     from ..ops.pallas_gemm import gf_matmul_pallas

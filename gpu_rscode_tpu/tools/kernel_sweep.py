@@ -208,6 +208,10 @@ def main():
         help="comma-separated subset of kernel bodies to sweep",
     )
     args = ap.parse_args()
+
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("kernel_sweep")), flush=True)
     bodies = [b.strip() for b in args.bodies.split(",") if b.strip()]
     unknown = [b for b in bodies if b not in BODIES]
     if unknown:

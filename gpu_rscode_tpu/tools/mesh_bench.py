@@ -51,6 +51,10 @@ def main() -> int:
 
     import jax
 
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("mesh_bench")), flush=True)
+
     label = backend_label()
     k, p = args.k, args.p
     m = (args.mb * 1024 * 1024) // k

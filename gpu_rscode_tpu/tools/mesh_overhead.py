@@ -57,6 +57,10 @@ def main() -> int:
 
     import jax
 
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("mesh_overhead")), flush=True)
+
     label = backend_label()
     k, p = args.k, args.p
     n_dev = len(jax.devices())

@@ -52,6 +52,10 @@ def main(argv=None) -> int:
 
     import jax
 
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("strategy_bench")), flush=True)
+
     from ..utils.backend import backend_label
 
     from .. import native

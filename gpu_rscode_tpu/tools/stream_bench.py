@@ -45,6 +45,9 @@ def main(argv=None) -> int:
     ap.add_argument("--dir", default=None, help="work dir (default: tmpdir)")
     args = ap.parse_args(argv)
 
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("stream_bench")), flush=True)
 
     k, p = args.k, args.p
     size = args.mb * 1024 * 1024

@@ -27,6 +27,10 @@ def main() -> int:
 
     import jax
 
+    from ..obs.runlog import capture_header
+
+    print(json.dumps(capture_header("w16_bench")), flush=True)
+
     from ..models.vandermonde import vandermonde_matrix
     from ..ops.gf import get_field
     from ..ops.gemm import gf_matmul_jit

@@ -102,6 +102,13 @@ class PhaseTimer:
     def total(self) -> float:
         return time.perf_counter() - self._t0
 
+    def phase_report(self) -> dict[str, float]:
+        """Accumulated seconds per phase, snapshotted under the recording
+        lock — the per-phase decomposition the run ledger (obs/runlog.py)
+        embeds in each record."""
+        with self._rec_lock:
+            return {name: round(v, 6) for name, v in self.acc.items()}
+
     def summary(self, data_bytes: int | None = None) -> str:
         comm = sum(v for k, v in self.acc.items() if self.is_comm(k))
         comp = sum(v for k, v in self.acc.items() if not self.is_comm(k))

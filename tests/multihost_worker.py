@@ -359,6 +359,74 @@ def main() -> None:
         pass
     multihost_utils.sync_global_devices("badmat_checked")
 
+    # --- fleet telemetry: every process dumps its own metrics snapshot and
+    # trace part ({path}.p{i}); obs/aggregate.py must fuse them into one
+    # snapshot whose counters equal the sum of the parts and one Perfetto
+    # file with a distinct process lane per host, time-aligned via the
+    # epoch captured at distributed.initialize --------------------------------
+    import json
+
+    from gpu_rscode_tpu.obs import aggregate, metrics as obs_metrics
+
+    tel_dir = os.path.join(workdir, "telemetry")
+    if pid == 0:
+        os.makedirs(tel_dir, exist_ok=True)
+    multihost_utils.sync_global_devices("telemetry_setup")
+    snap_base = os.path.join(tel_dir, "snap.json")
+    trace_base = os.path.join(tel_dir, "trace.json")
+    obs_metrics.REGISTRY.reset()
+    obs_metrics.force_enable()
+    tpath = os.path.join(tel_dir, "payload.bin")
+    if pid == 0:
+        with open(tpath, "wb") as fp:
+            fp.write(payload[:200_000])
+    multihost_utils.sync_global_devices("telemetry_payload")
+    api.encode_file(
+        tpath, kf, pf, mesh=mesh, segment_bytes=64 * 1024,
+        trace_path=aggregate.part_path(trace_base, pid, 2),
+    )
+    with open(aggregate.part_path(snap_base, pid, 2), "w") as fp:
+        json.dump(obs_metrics.unified_snapshot(), fp)
+    obs_metrics.force_enable(False)
+    multihost_utils.sync_global_devices("telemetry_dumped")
+    if pid == 0:
+        snap_parts = aggregate.find_parts(snap_base)
+        assert len(snap_parts) == 2, snap_parts
+        parts = [json.load(open(p)) for p in snap_parts]
+        merged = aggregate.merge_snapshot_files(snap_parts)
+
+        def ops_count(snap):
+            vals = snap["metrics"].get("rs_file_ops_total", {}).get(
+                "values", {})
+            return sum(v for k, v in vals.items() if 'op="encode"' in k)
+
+        want = sum(ops_count(p) for p in parts)
+        assert want >= 2, parts  # both processes recorded their encode
+        assert ops_count(merged) == want, (ops_count(merged), want)
+        staged = merged["metrics"]["rs_mesh_segments_staged_total"]["values"]
+        per_part = [
+            sum(p["metrics"]["rs_mesh_segments_staged_total"]
+                ["values"].values())
+            for p in parts
+        ]
+        assert sum(staged.values()) == sum(per_part), (staged, per_part)
+        # Prometheus text of the merged registry must render.
+        assert "rs_file_ops_total" in aggregate.render_text(
+            merged["metrics"])
+
+        trace_parts = aggregate.find_parts(trace_base)
+        assert len(trace_parts) == 2, trace_parts
+        fused = aggregate.merge_traces(
+            [json.load(open(p)) for p in trace_parts])
+        lanes = {e["pid"] for e in fused["traceEvents"]}
+        assert lanes == {1, 2}, lanes  # one process lane per host
+        for e in fused["traceEvents"]:
+            if "ts" in e:
+                assert e["ts"] >= 0, e  # epoch alignment stayed causal
+        with open(os.path.join(tel_dir, "fused.trace.json"), "w") as fp:
+            json.dump(fused, fp)
+    multihost_utils.sync_global_devices("telemetry_checked")
+
     print("MULTIHOST_OK", flush=True)
 
 

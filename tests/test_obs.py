@@ -510,11 +510,60 @@ def test_staging_ring_occupancy_drains_to_zero(tmp_path, clean_registry,
     assert snap["rs_staging_ring_occupancy"]["values"][""] == 0
 
 
-def test_cli_scrub_rejects_observability_flags(tmp_path):
-    from gpu_rscode_tpu.cli import main
+def test_cli_scrub_metrics_and_trace(tmp_path, clean_registry, capsys):
+    """Scrub rides the same observability surfaces as the data ops (the
+    PR-4 lift of the old rejection): --metrics-json dumps a snapshot
+    carrying the scrub counters, --trace exports the scan spans."""
+    import os
 
-    assert main(["--scrub", "-i", "x", "--metrics-json", "m.json"]) == 2
-    assert main(["--scrub", "-i", "x", "--trace", "t.json"]) == 2
+    from gpu_rscode_tpu.cli import main
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    path = _mkfile(tmp_path, 9_000)
+    assert main(["-k", "4", "-n", "6", "-e", path, "--checksum",
+                 "--quiet"]) == 0
+    os.unlink(chunk_file_name(path, 2))
+    with open(chunk_file_name(path, 3), "r+b") as fp:  # CRC mismatch
+        fp.seek(1)
+        fp.write(b"\xff")
+    mpath, tpath = str(tmp_path / "m.json"), str(tmp_path / "t.json")
+    assert main(["--scrub", "-i", path, "--metrics-json", mpath,
+                 "--trace", tpath]) == 0  # still decodable -> healthy exit
+    capsys.readouterr()
+    snap = json.load(open(mpath))
+    chunks = snap["metrics"]["rs_scrub_chunks_total"]["values"]
+    assert chunks['{state="healthy"}'] == 4
+    assert chunks['{state="missing"}'] == 1
+    assert chunks['{state="crc_mismatch"}'] == 1
+    scanned = snap["metrics"]["rs_scrub_archives_scanned_total"]["values"]
+    assert scanned['{outcome="damaged"}'] == 1
+    verdicts = snap["metrics"]["rs_scrub_verdicts_total"]["values"]
+    assert verdicts['{decodable="True"}'] == 1
+    trace = json.load(open(tpath))
+    scans = [e for e in trace["traceEvents"]
+             if e.get("ph") == "X" and e["name"] == "scan_chunks"]
+    assert scans and scans[0]["args"]["file"] == path
+
+
+def test_repair_outcome_counters(tmp_path, clean_registry, monkeypatch):
+    """The scrub/repair loop's verdict series: healthy vs rebuilt archives
+    and the rebuilt-chunk volume."""
+    import os
+
+    monkeypatch.setenv("RS_METRICS", "1")
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    path = _mkfile(tmp_path, 12_000)
+    api.encode_file(path, 4, 2, checksums=True)
+    assert api.repair_file(path) == []          # healthy pass
+    os.unlink(chunk_file_name(path, 0))
+    os.unlink(chunk_file_name(path, 4))
+    assert sorted(api.repair_file(path)) == [0, 4]
+    snap = metrics.REGISTRY.snapshot()
+    outcomes = snap["rs_repair_outcomes_total"]["values"]
+    assert outcomes['{outcome="healthy"}'] == 1
+    assert outcomes['{outcome="rebuilt"}'] == 1
+    assert snap["rs_repair_chunks_rebuilt_total"]["values"][""] == 2
 
 
 def test_cli_repair_metrics_json(tmp_path, clean_registry, capsys):

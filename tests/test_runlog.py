@@ -1,0 +1,262 @@
+"""Persistent run ledger (obs/runlog.py) + the `rs history` trend/regress
+surface.
+
+Covers the ISSUE contracts: every file-API op appends one structured
+record (config, bytes, wall, phase decomposition, outcome incl. the
+exception class of a failed run), size-capped rotation, torn-line
+tolerance, the shared capture header, and the regression watch — `rs
+history --regress` must exit non-zero on a synthetic 2x bandwidth
+regression injected into a temp ledger.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api, cli
+from gpu_rscode_tpu.obs import metrics, runlog
+from gpu_rscode_tpu.utils.timing import PhaseTimer
+
+
+@pytest.fixture
+def ledger(tmp_path, monkeypatch):
+    p = str(tmp_path / "runlog.jsonl")
+    monkeypatch.setenv("RS_RUNLOG", p)
+    yield p
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def _mkfile(tmp_path, size, name="f.bin", seed=0):
+    path = str(tmp_path / name)
+    rng = np.random.default_rng(seed)
+    open(path, "wb").write(
+        rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    )
+    return path
+
+
+def test_disabled_by_default(monkeypatch):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    assert not runlog.enabled()
+    runlog.record({"op": "noop"})  # must be a silent no-op, not an error
+
+
+def test_encode_appends_structured_record(tmp_path, ledger):
+    path = _mkfile(tmp_path, 300_000)
+    api.encode_file(path, 4, 2, w=8, checksums=True,
+                    timer=PhaseTimer(enabled=True))
+    recs = runlog.read_records(ledger)
+    assert len(recs) == 1
+    r = recs[0]
+    assert r["kind"] == "rs_run" and r["op"] == "encode"
+    assert r["config"] == {"k": 4, "n": 6, "w": 8, "strategy": "auto"}
+    assert r["bytes"] == 300_000
+    assert r["wall_s"] > 0
+    assert r["outcome"] == "ok" and r["error"] is None
+    assert r["run"] == runlog.run_id()
+    assert r["host"] and "backend" in r and r["proc"] == 0
+    # The PhaseTimer decomposition rode along (an enabled timer was given).
+    assert r["phases"] and any("(io)" in k for k in r["phases"])
+
+
+def test_failed_op_records_error_class(tmp_path, ledger):
+    path = _mkfile(tmp_path, 10_000)
+    api.encode_file(path, 4, 2)
+    with pytest.raises(FileNotFoundError):
+        api.decode_file(path, str(tmp_path / "no.conf"),
+                        str(tmp_path / "out"))
+    recs = runlog.read_records(ledger)
+    assert [r["op"] for r in recs] == ["encode", "decode"]
+    assert recs[1]["outcome"] == "error"
+    assert recs[1]["error"] == "FileNotFoundError"
+
+
+def test_nested_fleet_ops_each_record(tmp_path, ledger):
+    paths = [_mkfile(tmp_path, 50_000, name=f"a{i}.bin", seed=i)
+             for i in range(2)]
+    api.encode_fleet(paths, 4, 2, timer=PhaseTimer(enabled=True))
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    os.unlink(chunk_file_name(paths[0], 0))
+    api.repair_fleet(paths)
+    recs = runlog.read_records(ledger)
+    ops = [r["op"] for r in recs]
+    # Nested entry points record too (each per-file encode inside the
+    # fleet is a real operation); the outermost op closes last.
+    assert ops == ["encode", "encode", "encode_fleet", "repair_fleet"]
+    fleet_rec = recs[ops.index("encode_fleet")]
+    assert fleet_rec["files"] == 2
+    assert fleet_rec["bytes"] == 100_000  # summed over the fleet
+    # The fleet shares ONE timer: each nested record must carry its own
+    # DELTA, so the per-file phases partition the fleet's totals instead
+    # of each embedding the running cumulative sums.
+    n1, n2 = (recs[0]["phases"] or {}), (recs[1]["phases"] or {})
+    total = fleet_rec["phases"]
+    assert total
+    for key in set(n1) | set(n2):
+        assert n1.get(key, 0) + n2.get(key, 0) <= total.get(key, 0) + 1e-3, (
+            key, n1, n2, total)
+
+
+def test_rotation_keeps_one_generation(tmp_path, ledger, monkeypatch):
+    monkeypatch.setenv("RS_RUNLOG_MAX_BYTES", "600")
+    for i in range(30):
+        runlog.record({"op": "encode", "i": i})
+    assert os.path.exists(ledger + ".1")
+    assert os.path.getsize(ledger) <= 600 + 400  # cap + one record slack
+    recs = runlog.read_records(ledger)
+    # Rotated generation folds back in, oldest first, newest record last.
+    assert recs[-1]["i"] == 29
+    assert [r["i"] for r in recs] == sorted(r["i"] for r in recs)
+
+
+def test_torn_line_is_skipped(ledger):
+    runlog.record({"op": "encode", "bytes": 1}, ledger)
+    with open(ledger, "a") as fp:
+        fp.write('{"op": "enc')  # crashed writer's torn tail
+    runlog.record({"op": "decode", "bytes": 2}, ledger)
+    assert [r["op"] for r in runlog.read_records(ledger)] == [
+        "encode", "decode"]
+
+
+def test_capture_header_contract():
+    h = runlog.capture_header("io_bench")
+    assert h["kind"] == "capture_header" and h["tool"] == "io_bench"
+    for field in ("run", "ts", "host", "backend", "schema"):
+        assert field in h
+    assert h["run"] == runlog.run_id()
+    json.dumps(h)  # one JSONL-able line
+
+
+def test_metrics_digest_ties_to_registry(ledger):
+    metrics.force_enable()
+    metrics.REGISTRY.reset()
+    runlog.record({"op": "a"}, ledger)
+    metrics.REGISTRY.counter("x_total").inc()
+    runlog.record({"op": "b"}, ledger)
+    runlog.record({"op": "c"}, ledger)
+    d = [r["metrics_digest"] for r in runlog.read_records(ledger)]
+    assert d[0] != d[1] and d[1] == d[2]  # digest moves with the registry
+
+
+# ----- filter / throughput helpers ------------------------------------------
+
+
+def test_filter_records_by_op_and_config():
+    recs = [
+        {"op": "encode", "config": {"k": 4, "n": 6, "strategy": "auto"}},
+        {"op": "encode", "config": {"k": 10, "n": 14, "strategy": "auto"}},
+        {"op": "decode", "config": {"k": 4}},
+        {"kind": "capture_header", "tool": "io_bench"},
+        {"tool": "io_bench", "wall_s": 1.0, "bytes": 5},
+    ]
+    assert len(runlog.filter_records(recs, op="encode")) == 2
+    assert len(runlog.filter_records(recs, op="encode", k=4)) == 1
+    assert len(runlog.filter_records(recs, op="io_bench")) == 1  # tool match
+    assert len(runlog.filter_records(recs)) == 4  # header dropped
+
+
+def test_throughput_gbps_guards():
+    assert runlog.throughput_gbps(
+        {"bytes": 2e9, "wall_s": 1.0}) == pytest.approx(2.0)
+    assert runlog.throughput_gbps(
+        {"bytes": 2e9, "wall_s": 1.0, "outcome": "error"}) is None
+    assert runlog.throughput_gbps({"bytes": 0, "wall_s": 1.0}) is None
+    assert runlog.throughput_gbps({"wall_s": 1.0}) is None
+
+
+# ----- rs history -----------------------------------------------------------
+
+
+def _seed_history(ledger, wall, count=10, op="encode"):
+    for _ in range(count):
+        runlog.record(
+            {"op": op, "config": {"k": 10, "n": 14, "w": 8,
+                                  "strategy": "auto"},
+             "bytes": 10 ** 9, "wall_s": wall, "outcome": "ok"},
+            ledger,
+        )
+
+
+def test_history_lists_and_summarizes(ledger, capsys):
+    _seed_history(ledger, wall=0.5, count=5)
+    assert cli.main(["history", "--runlog", ledger, "--op", "encode"]) == 0
+    out = capsys.readouterr()
+    assert out.out.count("2.000GB/s") == 5
+    assert "mean 2.000 GB/s" in out.err
+    # JSON mode round-trips records.
+    assert cli.main(["history", "--runlog", ledger, "--json",
+                     "--last", "2"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2 and json.loads(lines[0])["op"] == "encode"
+
+
+def test_history_requires_a_ledger(monkeypatch, capsys, tmp_path):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    assert cli.main(["history"]) == 2
+    assert cli.main(["history", "--runlog",
+                     str(tmp_path / "missing.jsonl")]) == 1
+    capsys.readouterr()
+
+
+def test_history_regress_flags_2x_bandwidth_regression(ledger, capsys):
+    """The acceptance scenario: baseline at 2 GB/s, inject a synthetic 2x
+    regression (same bytes, doubled wall), --regress must exit non-zero;
+    the healthy window must pass."""
+    _seed_history(ledger, wall=0.5, count=10)       # 2.0 GB/s
+    assert cli.main(["history", "--runlog", ledger, "--op", "encode",
+                     "--save-baseline", "v1"]) == 0
+    assert os.path.exists(ledger + ".baselines.json")
+    assert cli.main(["history", "--runlog", ledger, "--op", "encode",
+                     "--regress", "v1"]) == 0       # healthy: same window
+    _seed_history(ledger, wall=1.0, count=10)       # 1.0 GB/s: 2x slower
+    rc = cli.main(["history", "--runlog", ledger, "--op", "encode",
+                   "--window", "10", "--regress", "v1"])
+    assert rc == 3
+    assert "REGRESSION" in capsys.readouterr().err
+    # Tightened threshold on the healthy window still passes.
+    assert cli.main(["history", "--runlog", ledger, "--op", "encode",
+                     "--window", "10", "--regress", "v1",
+                     "--threshold", "0.6"]) == 0
+
+
+def test_history_regress_unknown_baseline(ledger, capsys):
+    _seed_history(ledger, wall=0.5, count=3)
+    assert cli.main(["history", "--runlog", ledger,
+                     "--regress", "nope"]) == 1
+    assert "no baseline" in capsys.readouterr().err
+
+
+def test_history_ingests_bench_capture(tmp_path, capsys):
+    """A bench capture trends through the same reader, with the rows the
+    tools REALLY write: the header is identity (skipped, but its tool
+    answers --op for rows that carry none), and an io_ab-style row's
+    precomputed gbps counts despite having no bytes field."""
+    cap = str(tmp_path / "io_cap.jsonl")
+    with open(cap, "w") as fp:
+        fp.write(json.dumps(runlog.capture_header("io_bench")) + "\n")
+        for mode, gbps in (("sync", 2.0), ("writebehind", 4.0)):
+            fp.write(json.dumps({"metric": "io_ab", "op": "encode",
+                                 "mode": mode, "writers": 2,
+                                 "wall_s": 0.5, "gbps": gbps}) + "\n")
+    # Matched via the header's tool (rows carry no "tool" field) ...
+    assert cli.main(["history", "--runlog", cap, "--op", "io_bench"]) == 0
+    err = capsys.readouterr().err
+    assert "best 4.000 GB/s" in err
+    # ... and equally via the row's own op.
+    assert cli.main(["history", "--runlog", cap, "--op", "encode"]) == 0
+    assert "best 4.000 GB/s" in capsys.readouterr().err
+
+
+def test_cli_run_lands_in_ledger(tmp_path, ledger, capsys):
+    """End to end through the CLI: an `rs` encode appends a ledger record
+    with the CLI's enabled timer phases."""
+    path = _mkfile(tmp_path, 64_000)
+    assert cli.main(["-k", "3", "-n", "5", "-e", path, "--quiet"]) == 0
+    capsys.readouterr()
+    recs = runlog.read_records(ledger)
+    assert recs and recs[-1]["op"] == "encode"
+    assert recs[-1]["phases"]  # cli always passes an enabled PhaseTimer

@@ -1,0 +1,144 @@
+"""Live telemetry endpoint (obs/serve.py): /metrics, /healthz, /runs.
+
+The ISSUE acceptance: the endpoint answers /metrics with valid Prometheus
+text WHILE an encode runs — exercised here with a real encode on a
+background thread being scraped mid-flight.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api
+from gpu_rscode_tpu.obs import metrics, runlog, serve
+
+
+@pytest.fixture
+def server(tmp_path):
+    ledger = str(tmp_path / "runlog.jsonl")
+    srv = serve.start(0, runlog_path=ledger, addr="127.0.0.1")
+    yield srv, ledger
+    srv.shutdown()
+    srv.server_close()
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def _get(srv, path):
+    port = srv.server_address[1]
+    return urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                  timeout=10)
+
+
+def test_metrics_endpoint_serves_prometheus_text(server):
+    srv, _ = server
+    metrics.REGISTRY.reset()
+    metrics.counter("rq_total", "requests").labels(op="encode").inc(3)
+    resp = _get(srv, "/metrics")
+    assert resp.status == 200
+    assert resp.headers["Content-Type"].startswith("text/plain")
+    assert "version=0.0.4" in resp.headers["Content-Type"]
+    body = resp.read().decode()
+    assert '# TYPE rq_total counter' in body
+    assert 'rq_total{op="encode"} 3' in body
+
+
+def test_healthz(server):
+    srv, _ = server
+    got = json.load(_get(srv, "/healthz"))
+    assert got["ok"] is True
+    assert got["run"] == runlog.run_id()
+    assert got["metrics_enabled"] is True  # start() implies collection
+    assert got["uptime_s"] >= 0
+
+
+def test_runs_endpoint_tails_the_ledger(server):
+    srv, ledger = server
+    for i in range(60):
+        runlog.record({"op": "encode", "i": i}, ledger)
+    got = json.load(_get(srv, "/runs?n=2"))
+    assert [r["i"] for r in got] == [58, 59]
+    assert len(json.load(_get(srv, "/runs"))) == 50  # default tail
+    # n<=0 must not dump the whole ledger ([-0:] is everything) — it
+    # clamps back to the default 50.
+    assert len(json.load(_get(srv, "/runs?n=0"))) == 50
+    assert len(json.load(_get(srv, "/runs?n=-3"))) == 50
+
+
+def test_unknown_path_404(server):
+    srv, _ = server
+    with pytest.raises(urllib.error.HTTPError) as e:
+        _get(srv, "/nope")
+    assert e.value.code == 404
+
+
+def test_runs_404_without_a_ledger(tmp_path, monkeypatch):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    srv = serve.start(0, runlog_path=None, addr="127.0.0.1")
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(srv, "/runs")
+        assert e.value.code == 404
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_scrape_while_encode_runs(server, tmp_path):
+    """The acceptance scenario: /metrics answers with valid exposition
+    text concurrently with a live encode (the endpoint's whole point —
+    watching a long fleet job mid-flight)."""
+    srv, _ = server
+    metrics.REGISTRY.reset()
+    path = str(tmp_path / "live.bin")
+    rng = np.random.default_rng(0)
+    open(path, "wb").write(
+        rng.integers(0, 256, size=2_000_000, dtype=np.uint8).tobytes()
+    )
+    errors: list = []
+
+    def work():
+        try:
+            # Small segments -> many dispatch iterations to scrape into.
+            api.encode_file(path, 4, 2, segment_bytes=64 * 1024)
+        except Exception as e:  # pragma: no cover - fail the test below
+            errors.append(e)
+
+    t = threading.Thread(target=work)
+    t.start()
+    try:
+        bodies = []
+        while t.is_alive() and len(bodies) < 20:
+            bodies.append(_get(srv, "/metrics").read().decode())
+    finally:
+        t.join()
+    assert not errors, errors
+    assert bodies  # at least one scrape landed during the encode
+    final = _get(srv, "/metrics").read().decode()
+    assert 'rs_file_ops_total{op="encode"} 1' in final
+    assert "rs_segments_staged_total" in final
+
+
+def test_maybe_start_from_env(monkeypatch):
+    monkeypatch.delenv("RS_METRICS_PORT", raising=False)
+    assert serve.maybe_start_from_env() is None
+    monkeypatch.setenv("RS_METRICS_PORT", "0")
+    monkeypatch.setenv("RS_METRICS_ADDR", "127.0.0.1")
+    srv = serve.maybe_start_from_env()
+    try:
+        assert srv is not None
+        assert _get(srv, "/healthz").status == 200
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+    monkeypatch.setenv("RS_METRICS_PORT", "not-a-port")
+    with pytest.warns(UserWarning, match="endpoint not started"):
+        assert serve.maybe_start_from_env() is None

@@ -24,7 +24,16 @@ def _run_tool(mod: str, *args: str, timeout: int = 240):
     assert run.returncode == 0, run.stderr[-800:]
     lines = [l for l in run.stdout.splitlines() if l.startswith("{")]
     assert lines, f"no jsonl output from {mod}: {run.stdout[-400:]}"
-    return [json.loads(l) for l in lines]
+    rows = [json.loads(l) for l in lines]
+    # Capture contract (obs/runlog.capture_header): the FIRST json line of
+    # every bench tool is the shared identity header, so bench_captures/
+    # files are self-describing and `rs history` can ingest them.
+    hdr = rows[0]
+    assert hdr.get("kind") == "capture_header", hdr
+    assert hdr["tool"] == mod.rsplit(".", 1)[1]
+    for field in ("run", "host", "backend", "ts"):
+        assert field in hdr, hdr
+    return [r for r in rows if r.get("kind") != "capture_header"]
 
 
 def test_expand_probe_smoke():
