@@ -35,6 +35,7 @@ from .obs import metrics as _obs_metrics, runlog as _obs_runlog, \
     tracing as _obs_tracing
 from .parallel.io_executor import DrainExecutor, FleetPipeline
 from .parallel.pipeline import AsyncWindow, DeviceStagingRing, SegmentPrefetcher
+from .resilience import faults as _faults, retry as _retry
 from .utils.fileformat import (
     append_checksums,
     chunk_crc32,
@@ -60,18 +61,22 @@ class UndecidedSubsetError(ValueError):
 
 
 class ChunkIntegrityError(ValueError):
-    """A surviving chunk's bytes do not match its recorded CRC32.
+    """A surviving chunk's bytes are unusable — CRC mismatch, truncated or
+    vanished after the scan that selected it (the TOCTOU window), or
+    unreadable after retries.
 
     ``bad_chunks`` maps chunk index -> file path, so callers can build a new
     conf from different survivors (the checksum extension turns silent
-    corruption into a recoverable erasure).
+    corruption into a recoverable erasure); :func:`auto_decode_file` uses it
+    to exclude the named chunks and reselect automatically.
     """
 
-    def __init__(self, bad_chunks: dict[int, str]):
+    def __init__(self, bad_chunks: dict[int, str],
+                 reason: str = "chunk checksum mismatch (corrupt survivors)"):
         self.bad_chunks = dict(bad_chunks)
         names = ", ".join(f"{i}:{p}" for i, p in sorted(bad_chunks.items()))
         super().__init__(
-            f"chunk checksum mismatch (corrupt survivors): {names}; "
+            f"{reason}: {names}; "
             "pick different survivors in the conf file"
         )
 
@@ -104,6 +109,11 @@ def _observed_file_op(op: str):
         @functools.wraps(fn)
         def wrapper(*args, **kwargs):
             trace_path = kwargs.pop("trace_path", None)
+            # Rearm the retry budget per file-level entry: it bounds the
+            # retry storm of ONE operation; without this a long-lived
+            # process would silently lose all transient-retry protection
+            # once the cumulative budget drained (docs/RESILIENCE.md).
+            _retry.reset_budget()
             t0 = time.perf_counter()
             # Entry snapshot of a caller-supplied timer: nested fleet ops
             # share one, and the record must carry THIS op's delta, not
@@ -266,20 +276,41 @@ def _mesh_processes(mesh) -> list[int]:
     return sorted({d.process_index for d in mesh.devices.flat})
 
 
-def _open_chunk(path: str, chunk: int) -> np.ndarray:
+def _open_chunk(
+    path: str, chunk: int, index: int | None = None, scope: str = "read"
+) -> np.ndarray:
     """Read-only byte view of a chunk file, validated against the expected
     size.  Zero-size archives (chunk == 0, foreign reference encodes of an
-    empty file) get an empty array — np.memmap refuses zero-byte files."""
-    mm = (
-        np.zeros(0, dtype=np.uint8)
-        if chunk == 0
-        else np.memmap(path, dtype=np.uint8, mode="r")
-    )
-    if mm.shape[0] < chunk:
-        raise ValueError(
-            f"chunk {path!r} is {mm.shape[0]} bytes, expected {chunk}"
+    empty file) get an empty array — np.memmap refuses zero-byte files.
+
+    This is a resilience boundary (docs/RESILIENCE.md): the fault plane's
+    read hook fires here (``scope`` distinguishes decode reads from scrub
+    CRC reads), transient open failures retry under the default policy,
+    and — the TOCTOU fix — a chunk that passed the archive scan but shrank
+    before this open raises :class:`ChunkIntegrityError` naming ``index``
+    (when the caller supplies it) so :func:`auto_decode_file` can exclude
+    it and reselect survivors instead of dying on a raw ValueError."""
+
+    def attempt() -> np.ndarray:
+        _faults.on_read(path, index=index, scope=scope)
+        mm = (
+            np.zeros(0, dtype=np.uint8)
+            if chunk == 0
+            else np.memmap(path, dtype=np.uint8, mode="r")
         )
-    return mm
+        if mm.shape[0] < chunk:
+            if index is not None:
+                raise ChunkIntegrityError(
+                    {index: path},
+                    reason=f"chunk truncated after scan "
+                    f"({mm.shape[0]} of {chunk} bytes)",
+                )
+            raise ValueError(
+                f"chunk {path!r} is {mm.shape[0]} bytes, expected {chunk}"
+            )
+        return _faults.corrupt(path, index, mm, scope=scope)
+
+    return _retry.default_policy().call(attempt, op="chunk_open")
 
 
 def _write_empty_atomic(out_path: str) -> str:
@@ -462,13 +493,19 @@ def encode_file(
         """(k, cols) segment of the striped view, zero-padded.  Uses the
         native pread gather when built (one syscall per row instead of
         Python slice copies); NumPy fallback reuses the open memmap.
-        Runs on the prefetch worker thread (reads-only: safe)."""
+        Runs on the prefetch worker thread (reads-only: safe).  A
+        resilience read boundary: fault hook + transient-retry (the
+        gather writes a fresh buffer, so re-running it is exact)."""
         from . import native
 
-        with timer.phase("stage segment (io)"):
+        def attempt() -> np.ndarray:
+            _faults.on_read(file_name, scope="read")
             return native.stripe_read(
                 file_name, chunk, k, off, cols, total_size, fallback_src=src
             )
+
+        with timer.phase("stage segment (io)"):
+            return _retry.default_policy().call(attempt, op="encode_stage")
 
     parity_files: list = []
 
@@ -576,13 +613,23 @@ def _drain_parity(entry, parity_files, timer, crcs=None, k=0) -> None:
         parity_np = np.asarray(parity)  # blocks on device + D2H
     if parity_np.dtype != np.uint8:
         parity_np = np.ascontiguousarray(parity_np).view(np.uint8)  # LE symbol bytes
-    if crcs is not None:
-        # Segments drain strictly in column order (AsyncWindow is FIFO), so
-        # incremental CRC over each parity row is well-defined.
-        for j in range(parity_np.shape[0]):
-            crcs[k + j] = crc32_of(parity_np[j], crcs.get(k + j, 0))
+    # Segments drain strictly in column order (AsyncWindow is FIFO), so
+    # incremental CRC over each parity row is well-defined.  The CRC
+    # advance is computed BEFORE the write but committed only AFTER it
+    # lands: the writer lane may retry this whole drain on a transient
+    # write error (docs/RESILIENCE.md), and a half-committed accumulator
+    # would silently corrupt the checksum lines.
+    new_crcs = (
+        {
+            k + j: crc32_of(parity_np[j], crcs.get(k + j, 0))
+            for j in range(parity_np.shape[0])
+        }
+        if crcs is not None else None
+    )
     with timer.phase("write parity (io)"):
         native.scatter_write(parity_files, parity_np, off)
+    if new_crcs is not None:
+        crcs.update(new_crcs)
 
 
 def _encode_file_multiprocess(
@@ -883,6 +930,7 @@ def decode_file(
     verify_checksums: bool | None = None,
     timer: PhaseTimer | None = None,
     _fleet: FleetPipeline | None = None,
+    _fallback_rows: list[int] | None = None,
 ) -> str:
     """Rebuild ``in_file`` from the k surviving chunks listed in
     ``conf_file``.  Returns the output path (defaults to ``in_file``,
@@ -892,6 +940,18 @@ def decode_file(
     CRC32 extension lines when .METADATA carries them; True requires them;
     False skips verification.  Raises :class:`ChunkIntegrityError` naming
     the corrupt chunks so the caller can retry with different survivors.
+
+    ``_fallback_rows`` (private, supplied by :func:`auto_decode_file`):
+    extra healthy chunk indices whose files live next to ``in_file``.
+    With a pool, a *mid-stream* survivor failure — a read error that
+    outlives its retries, attributable to one chunk — triggers degraded
+    decode: the failed survivor is swapped for a pool chunk, the decode
+    matrix is re-derived, and streaming resumes from the first
+    uncommitted segment instead of aborting the run
+    (docs/RESILIENCE.md).  When no pool chunk can cover the failure, it
+    surfaces as :class:`ChunkIntegrityError` naming the survivor (the
+    open-time contract), so auto-decode's outer loop can exclude it and
+    reselect.
     """
     timer = timer or PhaseTimer(enabled=False)
     if len(_mesh_processes(mesh)) > 1:
@@ -941,11 +1001,32 @@ def decode_file(
     with timer.phase("open chunks (io)"):
         maps = []
         paths = []
-        for nm in names:
+        bad_open: dict[int, str] = {}
+        for pos, nm in enumerate(names):
             path = resolve(nm)
-            mm = _open_chunk(path, chunk)
+            try:
+                mm = _open_chunk(path, chunk, index=rows[pos])
+            except ChunkIntegrityError as e:
+                bad_open.update(e.bad_chunks)
+                continue
+            except OSError:
+                # The TOCTOU window: this chunk existed when the conf (or
+                # auto-decode scan) selected it but vanished or became
+                # unreadable (retries included) before this open.  Collect
+                # and name it instead of dying on a raw error so
+                # auto_decode_file can exclude it and reselect.  A conf
+                # naming a chunk that was NEVER found still raises
+                # FileNotFoundError from resolve() above.
+                bad_open[rows[pos]] = path
+                continue
             maps.append(mm)
             paths.append(path)
+        if bad_open:
+            raise ChunkIntegrityError(
+                bad_open,
+                reason="survivor chunks unreadable, truncated or vanished "
+                "after selection",
+            )
 
     if verify_checksums is not False:
         if verify_checksums and not crcs:
@@ -987,8 +1068,6 @@ def decode_file(
         k, p, w=w, strategy=strategy, mesh=mesh, stripe_sharded=stripe_sharded
     )
     total_mat = total_mat.astype(codec.gf.dtype)
-    with timer.phase("invert matrix"):
-        dec_mat = codec.decode_matrix_from(total_mat, rows)
 
     # Partial-recovery optimisation: surviving NATIVE chunks are already the
     # answer — copy their bytes straight through and run the recovery GEMM
@@ -1000,25 +1079,49 @@ def decode_file(
     # Only valid when the metadata matrix is systematic (identity top block)
     # — a foreign encoder may write any matrix, and we trust the file.
     systematic = np.array_equal(total_mat[:k], np.eye(k, dtype=total_mat.dtype))
-    native_pos = (
-        {r: idx for idx, r in enumerate(rows) if r < k} if systematic else {}
-    )
-    missing = [i for i in range(k) if i not in native_pos]
-    rec_row = {i: j for j, i in enumerate(missing)}
-    dec_missing = dec_mat[missing] if missing else None
 
     out_path = output or in_file
     seg_cols = _segment_cols(chunk, k, segment_bytes)
     tmp_path = out_path + ".rs_tmp"
-    # Read fds for the pread gather — only the recovery path stages
-    # segments; the all-natives path copies through the memmaps.
-    fps = [open(p, "rb") for p in paths] if dec_missing is not None else []
+    segments = _segment_spans(chunk, seg_cols)
+
+    # Mutable survivor state: the degraded-decode path swaps a mid-stream-
+    # failing survivor for a fallback chunk and resumes, so everything
+    # derived from the survivor set lives here and is rebuilt by _derive().
+    st: dict = {
+        "rows": list(rows), "maps": list(maps), "paths": list(paths),
+        "fps": [],
+    }
+
+    def _derive() -> None:
+        with timer.phase("invert matrix"):
+            dec_mat = codec.decode_matrix_from(total_mat, st["rows"])
+        native_pos = (
+            {r: idx for idx, r in enumerate(st["rows"]) if r < k}
+            if systematic else {}
+        )
+        missing = [i for i in range(k) if i not in native_pos]
+        st["native_pos"] = native_pos
+        st["rec_row"] = {i: j for j, i in enumerate(missing)}
+        st["dec_missing"] = dec_mat[missing] if missing else None
+        for fp in st["fps"]:
+            if not fp.closed:
+                fp.close()
+        # Read fds for the pread gather — only the recovery path stages
+        # segments; the all-natives path copies through the memmaps.
+        st["fps"] = (
+            [open(p_, "rb") for p_ in st["paths"]]
+            if st["dec_missing"] is not None else []
+        )
+
+    _derive()
+
     try:
         out_fp = open(tmp_path, "wb")
     except BaseException:
         # cleanup() below closes these, but it cannot exist yet without
         # out_fp — an unwritable output target must not leak k chunk fds.
-        for fp in fps:
+        for fp in st["fps"]:
             fp.close()
         raise
 
@@ -1028,33 +1131,47 @@ def decode_file(
         # the shared writer lane).
         out_fp.truncate(total_size)
         out_fp.close()
-        for fp in fps:
+        for fp in st["fps"]:
             fp.close()
         os.replace(tmp_path, out_path)
 
     def cleanup() -> None:
         if not out_fp.closed:
             out_fp.close()
-        for fp in fps:
+        for fp in st["fps"]:
             if not fp.closed:
                 fp.close()
         if os.path.exists(tmp_path):
             os.unlink(tmp_path)
 
-    key = _fleet.register(cleanup) if _fleet is not None else None
-    try:
+    # Contiguous segments fully written.  Drains commit in dispatch order
+    # (ordered lane / FIFO window), and dispatch order is ascending from
+    # each attempt's resume point, so the max committed index is also the
+    # length of the committed prefix — the degraded path's resume point.
+    committed = {"n": 0}
 
-        def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
-            lo = i * chunk + off
-            if lo >= total_size:
-                return
-            hi = min(lo + cols, total_size)
-            out_fp.seek(lo)
-            out_fp.write(row_bytes[: hi - lo].tobytes())
-            _obs_metrics.counter(
-                "rs_io_write_bytes_total",
-                "bytes write by the staging-I/O layer",
-            ).labels(call="stream_write").inc(hi - lo)
+    def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
+        lo = i * chunk + off
+        if lo >= total_size:
+            return
+        hi = min(lo + cols, total_size)
+        out_fp.seek(lo)
+        out_fp.write(row_bytes[: hi - lo].tobytes())
+        _obs_metrics.counter(
+            "rs_io_write_bytes_total",
+            "bytes write by the staging-I/O layer",
+        ).labels(call="stream_write").inc(hi - lo)
+
+    def _stream(segs) -> None:
+        # Bind THIS attempt's derived state into the closures: drains a
+        # fleet lane already queued keep the survivor set their recovery
+        # GEMM actually used (any valid set recovers identical bytes, so
+        # mixed-attempt drains still write a consistent output).
+        native_pos = st["native_pos"]
+        rec_row = st["rec_row"]
+        dec_missing = st["dec_missing"]
+        maps_l, paths_l = st["maps"], st["paths"]
+        rows_l, fps_l = st["rows"], st["fps"]
 
         def drain(tag, rec):
             off, cols = tag
@@ -1065,51 +1182,169 @@ def decode_file(
             with timer.phase("write output (io)"):
                 for i in range(k):
                     if i in native_pos:
-                        src_row = maps[native_pos[i]][off : off + cols]
+                        src_row = maps_l[native_pos[i]][off : off + cols]
                         write_row(i, off, cols, src_row)
                     else:
                         write_row(i, off, cols, rec_np[rec_row[i]])
+            committed["n"] = max(committed["n"], off // seg_cols + 1)
 
         from . import native
 
-        segments = _segment_spans(chunk, seg_cols)
-
-        if dec_missing is not None:
-
-            def stage(off: int, cols: int) -> np.ndarray:
-                # Native pread gather (one syscall per surviving chunk);
-                # memmap copies as fallback.  Runs on the prefetch
-                # worker so read IO overlaps the drain's output writes.
-                with timer.phase("stage segment (io)"):
-                    return native.gather_rows(
-                        fps, off, cols, fallback_maps=maps
-                    )
-
-            # Ordered write-behind: the streaming shared-fp seek/write
-            # commit must stay in column order, but it runs on the writer
-            # lane — the dispatch loop never blocks on D2H or fp.write.
-            with SegmentPrefetcher(
-                segments, stage, depth=pipeline_depth
-            ) as prefetch, _drain_ctx(_fleet) as dex, AsyncWindow(
-                pipeline_depth, drain, executor=dex
-            ) as window:
-                staging = _staging_ring(
-                    prefetch, codec, seg_cols, sym, pipeline_depth,
-                    out_rows=dec_missing.shape[0],
-                )
-                for (off, cols), seg in staging:
-                    with timer.phase("decode dispatch"), _dispatch_span(
-                        "decode", off, cols
-                    ):
-                        rec = codec.decode(dec_missing, seg)  # async
-                    window.push((off, cols), rec)
-        else:
+        if dec_missing is None:
             with _drain_ctx(_fleet) as dex, AsyncWindow(
                 pipeline_depth, drain, executor=dex
             ) as window:
-                for off, cols in segments:
+                for off, cols in segs:
                     # all natives survived: pure copy, nothing staged
                     window.push((off, cols), None)
+            return
+
+        def stage(off: int, cols: int) -> np.ndarray:
+            # Native pread gather (one syscall per surviving chunk);
+            # memmap copies as fallback.  Runs on the prefetch worker so
+            # read IO overlaps the drain's output writes.  A resilience
+            # read boundary: per-survivor fault hook + transient-retry
+            # (the gather fills a fresh buffer — idempotent).
+            def attempt() -> np.ndarray:
+                _faults.on_reads(paths_l, rows_l)
+                return native.gather_rows(
+                    fps_l, off, cols, fallback_maps=maps_l
+                )
+
+            with timer.phase("stage segment (io)"):
+                return _retry.default_policy().call(
+                    attempt, op="decode_stage"
+                )
+
+        # Ordered write-behind: the streaming shared-fp seek/write
+        # commit must stay in column order, but it runs on the writer
+        # lane — the dispatch loop never blocks on D2H or fp.write.
+        with SegmentPrefetcher(
+            segs, stage, depth=pipeline_depth
+        ) as prefetch, _drain_ctx(_fleet) as dex, AsyncWindow(
+            pipeline_depth, drain, executor=dex
+        ) as window:
+            staging = _staging_ring(
+                prefetch, codec, seg_cols, sym, pipeline_depth,
+                out_rows=dec_missing.shape[0],
+            )
+            for (off, cols), seg in staging:
+                with timer.phase("decode dispatch"), _dispatch_span(
+                    "decode", off, cols
+                ):
+                    rec = codec.decode(dec_missing, seg)  # async
+                window.push((off, cols), rec)
+
+    def _attribute(e: BaseException) -> list[int]:
+        """Survivor rows a mid-stream read failure pins on: injected
+        faults carry their chunk index; real failures are probed with
+        fstat (a chunk truncated or unlinked under us shows up here)."""
+        if isinstance(e, _faults.InjectedReadError):
+            return [e.index] if e.index in st["rows"] else []
+        bad = []
+        for r, fp in zip(st["rows"], st["fps"]):
+            try:
+                if os.fstat(fp.fileno()).st_size < chunk:
+                    bad.append(r)
+            except OSError:
+                bad.append(r)
+        return bad
+
+    pool = [r for r in (_fallback_rows or []) if r not in set(st["rows"])]
+
+    # Swapped-in pool chunks get the same read-time integrity treatment
+    # the initial survivors got: CRC-verified whenever the pre-pass above
+    # verified (verify_checksums=True, or default-on with CRC lines) —
+    # a pool chunk that rotted after the scan must not decode silently.
+    verify_swaps = verify_checksums is not False and bool(crcs)
+
+    def _reselect(bad: list[int]) -> bool:
+        """Swap the failed survivors for pool chunks and re-derive the
+        decode state; False when the pool cannot cover them (or every
+        replacement set hits a singular submatrix)."""
+        from .ops.inverse import SingularMatrixError
+
+        keep = [
+            (r, m, p_)
+            for r, m, p_ in zip(st["rows"], st["maps"], st["paths"])
+            if r not in bad
+        ]
+        while True:
+            fresh = []
+            while pool and len(keep) + len(fresh) < k:
+                r = pool.pop(0)
+                p_ = chunk_file_name(in_file, r)
+                try:
+                    m = _open_chunk(p_, chunk, index=r)
+                    if (
+                        verify_swaps and r in crcs
+                        and chunk_crc32(m, chunk, segment_bytes) != crcs[r]
+                    ):
+                        continue  # rotted after the scan; try the next
+                except (ValueError, OSError):
+                    continue  # this fallback is damaged too; try the next
+                fresh.append((r, m, p_))
+            if len(keep) + len(fresh) < k:
+                return False
+            merged = keep + fresh
+            st["rows"] = [r for r, _, _ in merged]
+            st["maps"] = [m for _, m, _ in merged]
+            st["paths"] = [p_ for _, _, p_ in merged]
+            try:
+                _derive()
+            except SingularMatrixError:
+                continue  # rare non-MDS corner: try further pool chunks
+            return True
+
+    key = _fleet.register(cleanup) if _fleet is not None else None
+    reselects = 0
+    max_reselects = max(0, _retry.int_env("RS_RETRY_RESELECT", 3))
+    try:
+        while True:
+            try:
+                _stream(segments[committed["n"]:])
+                break
+            except OSError as e:
+                bad = [r for r in _attribute(e) if r in st["rows"]]
+                if not bad:
+                    raise  # unattributable (e.g. a write-side error)
+                # Snapshot the failing rows' paths NOW: a failed
+                # _reselect leaves st mutated with the bad rows already
+                # dropped, and the error below must still name them.
+                bad_paths = {
+                    r: p_ for r, p_ in zip(st["rows"], st["paths"])
+                    if r in bad
+                }
+                swapped = False
+                if reselects < max_reselects:
+                    if _fleet is not None:
+                        # Let this archive's queued drains land (their
+                        # bytes are correct for their segments) so
+                        # ``committed`` is final before the resume point
+                        # is chosen.
+                        _fleet.executor.flush()
+                    swapped = _reselect(bad)
+                if not swapped:
+                    # Attributed but unswappable: surface the failing
+                    # survivor BY NAME so auto_decode_file's outer loop
+                    # can exclude it, rescan and reselect — the same
+                    # contract as an open-time (TOCTOU) failure.
+                    raise ChunkIntegrityError(
+                        bad_paths,
+                        reason="survivor chunk failed mid-stream reads "
+                        "past retries",
+                    ) from e
+                reselects += 1
+                _obs_tracing.instant(
+                    "degraded_reselect", lane="retry",
+                    bad=",".join(map(str, bad)),
+                    resume_segment=committed["n"],
+                )
+        if reselects:
+            _obs_metrics.counter(
+                "rs_degraded_decodes_total",
+                "decodes completed after mid-stream survivor reselection",
+            ).labels(stage="midstream").inc()
         if _fleet is not None:
             _fleet.commit(key, finalize)
         else:
@@ -1333,9 +1568,9 @@ def _decode_file_multiprocess(
 
     with timer.phase("open chunks (io)"):
         maps, paths = [], []
-        for nm in names:
+        for pos, nm in enumerate(names):
             path = resolve(nm)
-            mm = _open_chunk(path, chunk)
+            mm = _open_chunk(path, chunk, index=rows[pos])
             maps.append(mm)
             paths.append(path)
 
@@ -1529,6 +1764,17 @@ class _ChunkScan:
         """All chunk indices needing repair (corrupt or absent)."""
         return sorted(set(self.bad) | set(self.missing))
 
+    def excluding(self, bad: dict[int, str]) -> "_ChunkScan":
+        """A view of this scan with ``bad`` chunks demoted from healthy —
+        how auto-decode folds in failures discovered AFTER the scan
+        (TOCTOU opens, mid-stream read errors) before reselecting."""
+        return _ChunkScan(
+            self.in_file, self.total_size, self.p, self.k, self.total_mat,
+            self.w, self.crcs, self.chunk,
+            [i for i in self.healthy if i not in bad],
+            {**self.bad, **bad},
+        )
+
 
 def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
     """Discover chunk health next to ``in_file`` (size + CRC checks).
@@ -1566,7 +1812,23 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
                 chunk_states.labels(state="truncated").inc()
                 continue
             if i in crcs:
-                mm = _open_chunk(path, chunk)  # empty-safe for chunk == 0
+                try:
+                    # empty-safe for chunk == 0; scope="scrub" addresses
+                    # the fault plane's scrub boundary
+                    mm = _open_chunk(path, chunk, index=i, scope="scrub")
+                except ChunkIntegrityError:
+                    # Shrank between the getsize above and this open.
+                    bad[i] = path
+                    chunk_states.labels(state="truncated").inc()
+                    continue
+                except OSError:
+                    # Degraded read: a chunk that stays unreadable after
+                    # retries is damage to record, not a reason to fail
+                    # the whole archive scan — scrub carries on and
+                    # repair treats it like any other corrupt chunk.
+                    bad[i] = path
+                    chunk_states.labels(state="read_error").inc()
+                    continue
                 if chunk_crc32(mm, chunk, segment_bytes) != crcs[i]:
                     bad[i] = path
                     chunk_states.labels(state="crc_mismatch").inc()
@@ -1582,7 +1844,8 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
         )
 
 
-def _select_decodable_subset(scan: _ChunkScan):
+def _select_decodable_subset(scan: _ChunkScan, *, cap: int = 100,
+                             skip: int = 0):
     """Pick k healthy chunk indices whose submatrix inverts; returns
     ``(chosen, inverse)`` so callers don't re-invert.
 
@@ -1590,6 +1853,11 @@ def _select_decodable_subset(scan: _ChunkScan):
     parity; lazily falls back through other subsets on singularity.  The cap
     bounds pathological non-MDS matrices; Vandermonde/Cauchy submatrices
     are near-always invertible so the first try is the common case.
+
+    ``skip``/``cap`` window the candidate stream so a caller that caught
+    :class:`UndecidedSubsetError` can continue the search where the last
+    batch stopped (:func:`_select_subset_retrying`) instead of redoing —
+    and then abandoning — the same ``cap`` singular candidates.
     """
     from itertools import combinations
 
@@ -1606,7 +1874,9 @@ def _select_decodable_subset(scan: _ChunkScan):
     mat = scan.total_mat.astype(gf.dtype)
     capped = False
     for attempt, subset in enumerate(combinations(scan.healthy, k)):
-        if attempt >= 100:
+        if attempt < skip:
+            continue
+        if attempt >= skip + cap:
             capped = True
             break
         try:
@@ -1619,13 +1889,37 @@ def _select_decodable_subset(scan: _ChunkScan):
     # proven unrecoverable.
     if capped:
         raise UndecidedSubsetError(
-            f"no decodable k={k} subset within the first 100 candidate "
-            f"subsets of healthy chunks {scan.healthy}; more combinations "
-            "exist — this archive is not proven unrecoverable"
+            f"no decodable k={k} subset within candidate subsets "
+            f"[{skip}, {skip + cap}) of healthy chunks {scan.healthy}; "
+            "more combinations exist — this archive is not proven "
+            "unrecoverable"
         )
     raise ValueError(
         f"no decodable k={k} subset among healthy chunks {scan.healthy}"
     )
+
+
+def _select_subset_retrying(scan: _ChunkScan, attempts: int | None = None):
+    """Surface the singular-minor retry discipline (ops/inverse.py's
+    verify-and-fallback) at the subset level: on
+    :class:`UndecidedSubsetError` keep searching the next candidate batch
+    instead of propagating, up to ``RS_RETRY_SUBSET_ATTEMPTS`` batches of
+    100 (bounded — the candidate space is combinatorial)."""
+    cap = 100
+    attempts = (
+        max(1, _retry.int_env("RS_RETRY_SUBSET_ATTEMPTS", 3))
+        if attempts is None else max(1, attempts)
+    )
+    last: UndecidedSubsetError | None = None
+    for batch in range(attempts):
+        try:
+            return _select_decodable_subset(scan, cap=cap, skip=batch * cap)
+        except UndecidedSubsetError as e:
+            last = e
+            _obs_metrics.counter(
+                "rs_retries_total", "retry-policy outcomes"
+            ).labels(outcome="subset_retry").inc()
+    raise last
 
 
 @_observed_file_op("auto_decode")
@@ -1656,51 +1950,108 @@ def auto_decode_file(
     Raises ValueError when fewer than k healthy chunks remain or no
     decodable subset exists.  ``decode_kwargs`` pass through to decode_file.
 
+    Resilience (docs/RESILIENCE.md): this is the degraded-read entry
+    point.  Survivors that fail AFTER the scan selected them — truncated
+    or unlinked in the scan-to-decode window (TOCTOU), CRC-failing at
+    read time, or erroring mid-stream past their retries — surface as
+    :class:`ChunkIntegrityError`; this function excludes the named chunks,
+    rescans, reselects a fresh subset and redecodes, up to
+    ``RS_RETRY_RESELECT`` attempts.  The unselected healthy chunks are
+    also handed to :func:`decode_file` as a fallback pool, so a
+    *mid-stream* failure first tries an in-place survivor swap that
+    resumes from the failed segment instead of restarting.  A subset
+    search that hits its candidate cap (:class:`UndecidedSubsetError`)
+    continues into the next candidate batches instead of propagating
+    (``RS_RETRY_SUBSET_ATTEMPTS``).
+
     Integrity note: the scan CRC-verifies the chunks it selects, and the
     inner decode skips re-verification by default — corruption appearing in
-    the scan-to-decode window (TOCTOU) would decode silently.  Callers
-    needing end-to-end integrity on live-mutating storage should pass
-    ``verify_checksums=True`` explicitly to re-check at read time.
+    the scan-to-decode window (TOCTOU) is caught only when it changes a
+    chunk's size or readability.  Callers needing end-to-end integrity on
+    live-mutating storage should pass ``verify_checksums=True`` explicitly
+    to re-check content at read time.
     """
     conf_path = conf_out or (in_file + ".auto.conf")
     procs = _mesh_processes(decode_kwargs.get("mesh"))
-    # With a process-spanning mesh this is a collective: only the LEAD
-    # scans (one CRC read of the archive, not one per host) and writes the
-    # conf to the shared filesystem; peers wait at the barrier.  The
-    # scan verdict — ok or error — is broadcast before that barrier so a
-    # lead-side failure (corrupt metadata, unrecoverable archive) raises
-    # on every process instead of wedging the peers until coordinator
-    # teardown.
-    scan_err: Exception | None = None
-    if _is_lead(procs):
-        try:
-            scan = _scan_chunks(
-                in_file,
-                decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES),
-            )
-            chosen, _ = _select_decodable_subset(scan)
-            write_conf(
-                conf_path,
-                [os.path.basename(chunk_file_name(in_file, i))
-                 for i in chosen],
-            )
-        except Exception as e:
-            if len(procs) <= 1:
-                raise  # no peers to unblock — fail directly
-            scan_err = e
     if len(procs) > 1:
+        # With a process-spanning mesh this is a collective: only the LEAD
+        # scans (one CRC read of the archive, not one per host) and writes
+        # the conf to the shared filesystem; peers wait at the barrier.
+        # The scan verdict — ok or error — is broadcast before that
+        # barrier so a lead-side failure (corrupt metadata, unrecoverable
+        # archive) raises on every process instead of wedging the peers
+        # until coordinator teardown.  No degraded retry loop here: a
+        # mid-collective survivor swap would need its own barrier
+        # choreography on every process.
         from jax.experimental import multihost_utils
 
+        scan_err: Exception | None = None
+        if _is_lead(procs):
+            try:
+                scan = _scan_chunks(
+                    in_file,
+                    decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES),
+                )
+                chosen, _ = _select_subset_retrying(scan)
+                write_conf(
+                    conf_path,
+                    [os.path.basename(chunk_file_name(in_file, i))
+                     for i in chosen],
+                )
+            except Exception as e:
+                scan_err = e
         _broadcast_lead_verdict(
             scan_err, procs, "archive scan / survivor selection"
         )
         multihost_utils.sync_global_devices("rs_auto_conf_written")
-    # The scan above already CRC-verified exactly the chunks it selected —
-    # don't pay a second full read in decode_file unless the caller
-    # explicitly demanded verification.
-    if decode_kwargs.get("verify_checksums") is None:
-        decode_kwargs["verify_checksums"] = False
-    return decode_file(in_file, conf_path, output, **decode_kwargs)
+        if decode_kwargs.get("verify_checksums") is None:
+            decode_kwargs["verify_checksums"] = False
+        return decode_file(in_file, conf_path, output, **decode_kwargs)
+
+    attempts = max(1, _retry.int_env("RS_RETRY_RESELECT", 3) + 1)
+    excluded: dict[int, str] = {}
+    last: Exception | None = None
+    for attempt in range(attempts):
+        scan = _scan_chunks(
+            in_file, decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
+        )
+        if excluded:
+            scan = scan.excluding(excluded)
+        chosen, _ = _select_subset_retrying(scan)
+        write_conf(
+            conf_path,
+            [os.path.basename(chunk_file_name(in_file, i)) for i in chosen],
+        )
+        kwargs = dict(decode_kwargs)
+        # The scan above already CRC-verified exactly the chunks it
+        # selected — don't pay a second full read in decode_file unless
+        # the caller explicitly demanded verification.
+        if kwargs.get("verify_checksums") is None:
+            kwargs["verify_checksums"] = False
+        try:
+            out = decode_file(
+                in_file, conf_path, output,
+                _fallback_rows=[i for i in scan.healthy if i not in chosen],
+                **kwargs,
+            )
+        except (ChunkIntegrityError, FileNotFoundError) as e:
+            last = e
+            if isinstance(e, ChunkIntegrityError):
+                excluded.update(e.bad_chunks)
+            if attempt + 1 >= attempts:
+                raise
+            _obs_tracing.instant(
+                "degraded_reselect", lane="retry", attempt=attempt + 1,
+                error=type(e).__name__,
+            )
+            continue
+        if attempt:
+            _obs_metrics.counter(
+                "rs_degraded_decodes_total",
+                "decodes completed after survivor reselection",
+            ).labels(stage="reselect").inc()
+        return out
+    raise last  # unreachable: the last attempt re-raises above
 
 
 @_observed_file_op("repair")
@@ -1844,18 +2195,32 @@ def _repair_streamed(
             reb = np.asarray(rebuilt)
         if reb.dtype != np.uint8:
             reb = np.ascontiguousarray(reb).view(np.uint8)
+        # CRC advance committed only after the write lands — the writer
+        # lane may retry this whole drain (see _drain_parity).
+        delta = (
+            {t: crc32_of(reb[j], new_crcs.get(t, 0))
+             for j, t in enumerate(targets)}
+            if scan.crcs else None
+        )
         with timer.phase("write chunks (io)"):
             native.scatter_write([out_fps[t] for t in targets], reb, off)
-        if scan.crcs:
-            for j, t in enumerate(targets):
-                new_crcs[t] = crc32_of(reb[j], new_crcs.get(t, 0))
+        if delta is not None:
+            new_crcs.update(delta)
+
+    surv_paths = [chunk_file_name(in_file, i) for i in chosen]
 
     def stage(off: int, cols: int) -> np.ndarray:
-        # On the prefetch worker: survivor reads overlap rebuilt-chunk writes.
-        with timer.phase("stage segment (io)"):
+        # On the prefetch worker: survivor reads overlap rebuilt-chunk
+        # writes.  Resilience read boundary (fault hook + transient-retry
+        # into a fresh buffer), like the decode stage.
+        def attempt() -> np.ndarray:
+            _faults.on_reads(surv_paths, chosen)
             return native.gather_rows(
                 surv_fps, off, cols, fallback_maps=surv_maps
             )
+
+        with timer.phase("stage segment (io)"):
+            return _retry.default_policy().call(attempt, op="repair_stage")
 
     def finalize() -> None:
         # Promote only after every rebuilt segment landed: standalone this

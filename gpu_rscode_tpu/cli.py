@@ -74,6 +74,11 @@ Observability (docs/OBSERVABILITY.md):
             on multi-process jobs)
             RS_RUNLOG=PATH appends one ledger record per operation;
             RS_METRICS_PORT=P serves /metrics live during the run
+Resilience (docs/RESILIENCE.md):
+            [--faults SPEC] (deterministic fault injection at the I/O
+            boundaries, e.g. "read:ioerror@p=0.02;write:torn@after=1MiB";
+            equivalent to RS_FAULTS=SPEC, seeded by RS_FAULTS_SEED;
+            RS_RETRY_* env knobs tune the retry/backoff policy)
 Subcommands: rs stats [--text] [--workload]
             (dump the unified observability snapshot of this process;
             --text = Prometheus exposition, --workload = run a synthetic
@@ -88,6 +93,10 @@ Subcommands: rs stats [--text] [--workload]
             rs aggregate INPUT... [--snapshot-out F] [--trace-out F] [--text]
             (merge per-process {path}.p<i> snapshots/traces from a
             multi-host run into one snapshot / one Perfetto file)
+            rs chaos [--seed S] [--iters N] [--only I] [--repro JSON]
+            (seeded encode -> corrupt -> scrub/decode/repair loop,
+            differential-checked against the native oracle; failures
+            shrink to a one-line reproducer)
 """
 
 
@@ -363,6 +372,10 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.aggregate import main as _aggregate_main
 
         return _aggregate_main(argv[1:])
+    if argv and argv[0] == "chaos":
+        from .resilience.chaos import main as _chaos_main
+
+        return _chaos_main(argv[1:])
     try:
         # gnu_getopt: flags may follow the fleet-repair positional archives
         # (the reference surface has no positionals, so ordering semantics
@@ -386,6 +399,7 @@ def main(argv: list[str] | None = None) -> int:
                 "scrub",
                 "metrics-json=",
                 "trace=",
+                "faults=",
             ],
         )
     except getopt.GetoptError as e:
@@ -419,6 +433,7 @@ def main(argv: list[str] | None = None) -> int:
     scrub = False
     metrics_json = None
     trace_path = None
+    faults_spec = None
 
     repair_requested = any(fl in ("--repair", "--scrub") for fl, _ in opts)
     for flag, val in opts:
@@ -479,6 +494,24 @@ def main(argv: list[str] | None = None) -> int:
             metrics_json = val
         elif f == "--trace":
             trace_path = val
+        elif f == "--faults":
+            faults_spec = val
+
+    fault_plan = None
+    if faults_spec is not None:
+        # Validate the grammar HERE (usage error, not a mid-run surprise);
+        # the plan is activated around the operation below — identical
+        # semantics to RS_FAULTS=SPEC (seeded by RS_FAULTS_SEED) without
+        # mutating the process env, which would leak the fault plane into
+        # later in-process main() calls (tests, embedders).
+        from .resilience import faults as _res_faults
+
+        try:
+            fault_plan = _res_faults.parse_plan(
+                faults_spec, seed=_res_faults.env_seed()
+            )
+        except ValueError as e:
+            return _fail(f"rs: bad --faults spec: {e}")
 
     if repair and scrub:
         return _fail("rs: --repair and --scrub conflict")
@@ -631,6 +664,12 @@ def main(argv: list[str] | None = None) -> int:
 
         ctx = jax.profiler.trace(profile_dir)
         ctx.__enter__()
+    fault_ctx = None
+    if fault_plan is not None:
+        from .resilience import faults as _res_faults
+
+        fault_ctx = _res_faults.activate(fault_plan)
+        fault_ctx.__enter__()
     try:
         if op == "encode":
             if native_num <= 0 or total_num <= 0 or not in_file:
@@ -738,6 +777,8 @@ def main(argv: list[str] | None = None) -> int:
         print(f"rs: error: {e}", file=sys.stderr)
         return 1
     finally:
+        if fault_ctx is not None:
+            fault_ctx.__exit__(None, None, None)
         if ctx is not None:
             ctx.__exit__(None, None, None)
         # In the finally: the snapshot must land on EVERY exit from the

@@ -53,6 +53,7 @@ import time
 from collections.abc import Callable
 
 from ..obs import metrics as _metrics, tracing as _tracing
+from ..resilience import faults as _faults, retry as _retry
 
 
 def writer_count(default: int = 1) -> int:
@@ -161,8 +162,27 @@ class DrainExecutor:
 
     def _run_task(self, fn: Callable[[], None], nbytes: int, lane: str) -> None:
         t0 = time.perf_counter()
-        with _tracing.span("write_drain", lane=lane, nbytes=nbytes):
+        # Resilience write boundary (docs/RESILIENCE.md): the fault
+        # plane's write hook fires per attempt (injected ioerror/torn/
+        # delay), and transient failures — injected or real — retry the
+        # whole drain under the default policy.  Drains are idempotent by
+        # construction: offset-addressed pwrites, restart-from-scratch
+        # copies, and incremental-CRC commits deferred until after the
+        # write landed.  The lane's attempted-byte accounting (torn
+        # faults) counts a task's bytes once, not per retry.
+        first = True
+
+        def attempt() -> None:
+            nonlocal first
+            # Flag cleared BEFORE the hook: if the hook itself raises on
+            # the first attempt, the retry must not re-count the bytes.
+            nb = nbytes if first else 0
+            first = False
+            _faults.on_write(lane, nb)
             fn()
+
+        with _tracing.span("write_drain", lane=lane, nbytes=nbytes):
+            _retry.default_policy().call(attempt, op="write_drain")
         _metrics.counter(
             "rs_io_write_seconds_total",
             "wall seconds spent in drain (D2H wait + write) tasks",
@@ -335,7 +355,17 @@ def run_rows(n: int, fn: Callable[[int], None]) -> None:
     """Run ``fn(i)`` for each row ``i`` in ``range(n)``, fanned across the
     shared reader pool (``RS_IO_READERS`` wide; serial when 1 or when the
     row count doesn't warrant threads).  Blocks until every row completed;
-    the first row exception re-raises here."""
+    the first row exception re-raises here.
+
+    Deliberately NOT a fault/retry boundary of its own: every caller is a
+    segment gather that api.py already wraps in the fault plane's
+    per-survivor read hook plus the default retry policy (op=
+    encode/decode/repair_stage).  A second layer here would double the
+    effective injected-fault rate on toolchain-less builds only (this
+    pool is the native gather's fallback), raise unattributable faults
+    (no chunk index -> the degraded survivor swap can't engage) and burn
+    (attempts+1)^2 nested retries — so the read lane's resilience
+    boundary stays one level up, uniform across builds."""
     workers = min(reader_count(), n)
     if workers <= 1:
         for i in range(n):

@@ -1,0 +1,30 @@
+"""Resilience subsystem — fault injection, retry/backoff, chaos testing.
+
+The (n, k) Reed-Solomon pipeline exists to survive failures, so the stack
+must be able to *provoke* them: this package is the fault plane and the
+recovery policy the file layer (api.py) and the I/O lanes
+(parallel/io_executor.py) hook into, plus the seeded chaos harness that
+differential-checks the whole loop against the native oracle.
+
+* :mod:`.faults` — a deterministic, seedable fault-injection plane
+  (``RS_FAULTS`` / ``--faults`` specs like ``read:ioerror@p=0.02``),
+  compiled to a shared no-op when unset so tier-1 overhead is zero.
+* :mod:`.retry` — bounded exponential backoff with seeded jitter,
+  transient/fatal error classification and a process-wide retry budget,
+  applied to chunk reads and the write-behind drain lanes.
+* :mod:`.chaos` — the ``rs chaos`` harness: seeded encode ->
+  corrupt-per-schedule -> scrub/auto-decode/repair, every output
+  differential-checked against the native oracle, failures shrunk to a
+  one-line reproducer.
+
+See docs/RESILIENCE.md for the fault-spec grammar, the retry knobs and
+the degraded-decode semantics.
+
+Import cost: stdlib only (no jax, no numpy) — :mod:`.faults` and
+:mod:`.retry` are imported by ``parallel.io_executor``, which keeps that
+contract.  :mod:`.chaos` imports the api lazily and is NOT imported here.
+"""
+
+from . import faults, retry
+
+__all__ = ["faults", "retry"]

@@ -1,0 +1,576 @@
+"""Seeded chaos harness — ``rs chaos`` (docs/RESILIENCE.md).
+
+The differential loop the whole resilience subsystem is verified by:
+
+    seeded encode -> corrupt per schedule -> scrub / auto-decode / repair
+    -> differential-check every output against the native oracle
+
+Every iteration is a pure function of ``(seed, iteration)``: the config
+(k, p, w, size), the file bytes, the corruption schedule (bitrot /
+torn-write truncation / unlink) and the fault plan injected during the
+recovery phase (read ioerror, delay, chunk-scoped mid-stream faults) all
+derive from one ``random.Random`` stream, and the fault plane's own
+decisions hash from the same derived seed — so ``rs chaos --seed S`` is
+bit-reproducible: the same seed yields the same schedule and the same
+pass/fail verdict every run, anywhere (targets are keyed by basename,
+never by temp-dir path).
+
+Checks per iteration (any miss is a failure):
+
+* encode differential: every chunk file byte-equals the native oracle's
+  encode of the same data (``native.gemm`` for w=8 — the cpu-rs oracle —
+  or the GF(2^16) host oracle for wide symbols);
+* scrub exactness: ``scan_file`` reports exactly the damaged chunks, and
+  its ``decodable`` verdict matches the schedule's damage count vs p;
+* recoverable archives: ``auto_decode_file`` output byte-equals the
+  original AND an independent oracle decode of the conf it chose;
+  ``repair_file`` rebuilds exactly the damaged set and leaves every
+  chunk byte-equal to the oracle encode;
+* unrecoverable archives (damage > p): decode and repair must raise
+  (never fabricate bytes), and surviving chunks must be left untouched.
+
+A failing iteration is shrunk greedily — drop one schedule event (or the
+fault plan) at a time, keep what still fails — and reported as ONE line::
+
+    REPRODUCE: {"seed": S, "iter": I, "k": .., "events": [..], ...}
+
+which ``rs chaos --repro '<that json>'`` replays directly (``--seed S
+--only I`` replays the unshrunk original).  Outcomes are recorded through
+the run ledger (``RS_RUNLOG``, obs/runlog.py) as ``op="chaos_iter"``
+records plus the ``rs_chaos_iterations_total{verdict}`` counter.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import shutil
+import sys
+import tempfile
+from contextlib import contextmanager, nullcontext
+
+from ..obs import metrics as _metrics, runlog as _runlog
+from . import faults as _faults, retry as _retry
+
+# Small segments force multi-segment streaming even for the harness's
+# small files, so the mid-stream (degraded decode) paths actually run.
+_SEGMENT_BYTES = 4096
+
+# The bit-reproducibility contract requires verdicts to be a function of
+# the seed ALONE, so every knob the recovery path reads from the env is
+# pinned for the iteration's duration: ambient RS_RETRY_* would change
+# how many injected faults are absorbed (RS_RETRY_ATTEMPTS=0 fails seeds
+# verified green; a high value silently skips the degraded-swap path the
+# times= budgets are tuned to), and an ambient RS_FAULTS would stack a
+# second schedule under iterations that planned none.
+_PINNED_ENV = {
+    "RS_FAULTS": None,          # the iteration's plan activates explicitly
+    "RS_FAULTS_SEED": None,
+    "RS_RETRY_ATTEMPTS": "3",   # the default the times= fire budgets match
+    "RS_RETRY_BASE_MS": "1",
+    "RS_RETRY_MAX_MS": "20",
+    "RS_RETRY_SEED": "0",
+    "RS_RETRY_BUDGET": "256",
+    "RS_RETRY_RESELECT": "3",
+    "RS_RETRY_SUBSET_ATTEMPTS": "3",
+}
+
+
+@contextmanager
+def _pinned_env():
+    saved = {k: os.environ.get(k) for k in _PINNED_ENV}
+    try:
+        for k, v in _PINNED_ENV.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+class ChaosFailure(Exception):
+    """One iteration's verdict went wrong; ``cfg`` is the iteration
+    config that reproduces it."""
+
+    def __init__(self, cfg: dict, what: str):
+        self.cfg = cfg
+        self.what = what
+        super().__init__(f"iter {cfg.get('iter')}: {what}")
+
+
+def _iter_rng(seed: int, i: int) -> random.Random:
+    return random.Random(f"rs-chaos:{seed}:{i}")
+
+
+def plan_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
+    """The deterministic schedule for iteration ``i`` of master ``seed``."""
+    rng = _iter_rng(seed, i)
+    k = rng.randint(2, 6)
+    p = rng.randint(1, 3)
+    w = 16 if rng.random() < 0.2 else 8
+    size = rng.randint(1, max_bytes)
+    # ~15% of iterations damage MORE than p chunks: the harness must also
+    # prove the stack says "unrecoverable" instead of fabricating bytes.
+    overkill = rng.random() < 0.15
+    n_damage = (
+        rng.randint(p + 1, min(k + p, p + 2)) if overkill
+        else rng.randint(0, p)
+    )
+    targets = sorted(rng.sample(range(k + p), n_damage))
+    events = []
+    for t in targets:
+        kind = rng.choice(["bitrot", "torn", "unlink"])
+        if kind == "bitrot":
+            events.append({"kind": "bitrot", "chunk": t,
+                           "count": rng.randint(1, 16)})
+        elif kind == "torn":
+            # A torn write: only a prefix of the chunk landed.
+            events.append({"kind": "torn", "chunk": t,
+                           "keep_frac": round(rng.random() * 0.9, 3)})
+        else:
+            events.append({"kind": "unlink", "chunk": t})
+    fault_bits = []
+    if rng.random() < 0.5:
+        fault_bits.append(
+            f"read:ioerror@p={round(rng.uniform(0.005, 0.03), 4)}"
+        )
+    if rng.random() < 0.25:
+        fault_bits.append("read:delay@ms=1,p=0.05")
+    healthy_natives = [c for c in range(k) if c not in targets]
+    if (
+        rng.random() < 0.5
+        and 0 < len(targets) < p          # spare healthy chunks exist
+        and any(t < k for t in targets)   # recovery decode, not passthrough
+        and healthy_natives
+    ):
+        # A healthy NATIVE that dies MID-STREAM (its open is fine, the
+        # later segment gathers fail, bounded by times=): natives-first
+        # selection guarantees it is a chosen survivor of a recovery
+        # decode, so the fault really fires and exercises degraded
+        # decode's in-place survivor swap + resume.  Pinned to the read
+        # boundary so scrub CRC passes don't consume the fire budget.
+        victim = rng.choice(healthy_natives)
+        fault_bits.append(
+            f"chunk{victim}:ioerror@from=2,times=4,scope=read"
+        )
+    return {
+        "seed": seed,
+        "iter": i,
+        "k": k,
+        "p": p,
+        "w": w,
+        "size": size,
+        "events": events,
+        "faults": ";".join(fault_bits),
+    }
+
+
+# -- oracle -------------------------------------------------------------------
+
+
+def _oracle_chunks(data: bytes, k: int, p: int, w: int, total_mat):
+    """Every chunk's bytes per the native oracle: natives are straight
+    zero-padded stripes; parity is the oracle GEMM (``native.gemm`` — the
+    cpu-rs reference path — for w=8, the GF host oracle for w=16)."""
+    import numpy as np
+
+    from .. import native
+    from ..ops.gf import get_field
+    from ..utils.fileformat import chunk_size_for
+
+    sym = w // 8
+    chunk = chunk_size_for(len(data), k, sym)
+    padded = np.zeros(k * chunk, dtype=np.uint8)
+    padded[: len(data)] = np.frombuffer(data, dtype=np.uint8)
+    natives = padded.reshape(k, chunk)
+    gf = get_field(w)
+    mat = np.asarray(total_mat, dtype=gf.dtype)[k:]
+    if w == 8:
+        parity = native.gemm(mat.astype(np.uint8), natives)
+    else:
+        parity = np.ascontiguousarray(
+            gf.matmul(mat, natives.view(np.uint16))
+        ).view(np.uint8)
+    return [natives[i].tobytes() for i in range(k)] + [
+        parity[j].tobytes() for j in range(p)
+    ]
+
+
+def _oracle_decodable(total_mat, healthy, k: int, w: int) -> bool:
+    """Ground-truth decodability: some k-subset of the healthy chunks
+    inverts under the host oracle.  Exhaustive — the harness's chunk
+    counts keep the combination space tiny — so scrub's verdict is
+    checked against truth even for non-MDS Vandermonde corners where
+    "damage <= p" over-promises."""
+    from itertools import combinations
+
+    import numpy as np
+
+    from ..ops.gf import get_field
+    from ..ops.inverse import SingularMatrixError, invert_matrix
+
+    if len(healthy) < k:
+        return False
+    gf = get_field(w)
+    mat = np.asarray(total_mat, dtype=gf.dtype)
+    for subset in combinations(healthy, k):
+        try:
+            invert_matrix(mat[list(subset)], gf)
+            return True
+        except SingularMatrixError:
+            continue
+    return False
+
+
+def _oracle_decode(in_file: str, conf_path: str, total_size: int, k: int,
+                   w: int, total_mat) -> bytes:
+    """Independent host/native reconstruction from the conf the decode
+    under test actually used — the differential witness."""
+    import numpy as np
+
+    from .. import native
+    from ..ops.gf import get_field
+    from ..ops.inverse import invert_matrix
+    from ..utils.fileformat import (
+        chunk_size_for, parse_chunk_index, read_conf,
+    )
+
+    sym = w // 8
+    chunk = chunk_size_for(total_size, k, sym)
+    names = read_conf(conf_path)
+    rows = [parse_chunk_index(nm) for nm in names]
+    base = os.path.dirname(os.path.abspath(in_file))
+    stacked = np.stack([
+        np.fromfile(os.path.join(base, os.path.basename(nm)),
+                    dtype=np.uint8, count=chunk)
+        for nm in names
+    ])
+    gf = get_field(w)
+    sub = np.asarray(total_mat, dtype=gf.dtype)[rows]
+    if w == 8:
+        inv = native.invert(sub.astype(np.uint8))
+        out = native.gemm(inv, stacked)
+    else:
+        inv = invert_matrix(sub, gf)
+        out = np.ascontiguousarray(
+            gf.matmul(inv, stacked.view(np.uint16))
+        ).view(np.uint8)
+    return out.reshape(-1).tobytes()[:total_size]
+
+
+# -- one iteration ------------------------------------------------------------
+
+
+def _apply_events(fname: str, events, chunk: int, rng: random.Random) -> None:
+    from ..utils.fileformat import chunk_file_name
+
+    for ev in events:
+        path = chunk_file_name(fname, ev["chunk"])
+        if ev["kind"] == "unlink":
+            os.unlink(path)
+        elif ev["kind"] == "torn":
+            keep = int(chunk * ev["keep_frac"])
+            if keep >= chunk:
+                keep = max(0, chunk - 1)
+            with open(path, "r+b") as fp:
+                fp.truncate(keep)
+        else:  # bitrot
+            # DISTINCT positions (capped at the chunk's bit count): with
+            # replacement, an even number of hits on one bit nets to
+            # zero corruption and the scrub-exactness check would fail
+            # on a perfectly healthy stack.
+            nbits = max(1, chunk * 8)
+            with open(path, "r+b") as fp:
+                buf = bytearray(fp.read())
+                for bit in rng.sample(range(nbits),
+                                      min(ev["count"], nbits)):
+                    buf[bit // 8] ^= 1 << (bit % 8)
+                fp.seek(0)
+                fp.write(bytes(buf))
+
+
+def _check(cond: bool, cfg: dict, what: str) -> None:
+    if not cond:
+        raise ChaosFailure(cfg, what)
+
+
+def run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
+    """Execute one scheduled iteration under the pinned recovery env
+    (verdicts are a function of the seed alone); returns its outcome
+    record or raises :class:`ChaosFailure` with the reproducing config."""
+    with _pinned_env():
+        return _run_iteration(cfg, workdir, keep=keep)
+
+
+def _run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
+    from .. import api
+    from ..utils.fileformat import (
+        chunk_file_name, chunk_size_for, metadata_file_name,
+        read_metadata_ext,
+    )
+
+    seed, i = cfg["seed"], cfg["iter"]
+    k, p, w, size = cfg["k"], cfg["p"], cfg["w"], cfg["size"]
+    rng = _iter_rng(seed, i)
+    rng.random()  # decouple from plan_iteration's draws deterministically
+    base = os.path.join(workdir, f"iter{i}")
+    os.makedirs(base, exist_ok=True)
+    fname = os.path.join(base, f"chaos_{i}.bin")
+    data = random.Random(f"rs-chaos-data:{seed}:{i}").randbytes(size)
+    ok = False
+    try:
+        with open(fname, "wb") as fp:
+            fp.write(data)
+        api.encode_file(
+            fname, k, p, checksums=True, w=w, segment_bytes=_SEGMENT_BYTES
+        )
+        total_size, p_m, k_m, total_mat, w_m, _crcs = read_metadata_ext(
+            metadata_file_name(fname)
+        )
+        _check((k_m, p_m, w_m, total_size) == (k, p, w, size), cfg,
+               "metadata disagrees with the encode config")
+        oracle = _oracle_chunks(data, k, p, w, total_mat)
+        for c in range(k + p):
+            got = open(chunk_file_name(fname, c), "rb").read()
+            _check(got == oracle[c], cfg,
+                   f"encode differential mismatch on chunk {c}")
+
+        chunk = chunk_size_for(size, k, w // 8)
+        _apply_events(fname, cfg["events"], chunk, rng)
+        damaged = sorted({ev["chunk"] for ev in cfg["events"]})
+
+        plan = (
+            _faults.parse_plan(cfg["faults"], seed=(seed * 1_000_003 + i))
+            if cfg["faults"] else None
+        )
+        _retry.reset_budget()
+        with _faults.activate(plan) if plan else nullcontext():
+            report = api.scan_file(fname, segment_bytes=_SEGMENT_BYTES)
+            scan_damaged = sorted(
+                set(report["corrupt"]) | set(report["missing"])
+            )
+            _check(scan_damaged == damaged, cfg,
+                   f"scrub saw {scan_damaged}, schedule damaged {damaged}")
+            recoverable = _oracle_decodable(
+                total_mat, report["healthy"], k, w
+            )
+            _check(report["decodable"] is recoverable, cfg,
+                   f"scrub verdict {report['decodable']} vs oracle "
+                   f"decodable={recoverable}")
+            _check(recoverable is (len(damaged) <= p) or not recoverable,
+                   cfg, "oracle says decodable with more than p chunks "
+                   "damaged (impossible)")
+            if recoverable:
+                out = api.auto_decode_file(
+                    fname, fname + ".dec", segment_bytes=_SEGMENT_BYTES
+                )
+                _check(open(out, "rb").read() == data, cfg,
+                       "auto-decode output != original bytes")
+                _check(
+                    _oracle_decode(fname, fname + ".auto.conf", size, k, w,
+                                   total_mat) == data,
+                    cfg, "oracle decode of the chosen conf != original",
+                )
+                rebuilt = api.repair_file(
+                    fname, segment_bytes=_SEGMENT_BYTES
+                )
+                _check(sorted(rebuilt) == damaged, cfg,
+                       f"repair rebuilt {sorted(rebuilt)}, expected "
+                       f"{damaged}")
+                for c in range(k + p):
+                    got = open(chunk_file_name(fname, c), "rb").read()
+                    _check(got == oracle[c], cfg,
+                           f"post-repair differential mismatch on chunk {c}")
+                post = api.scan_file(fname, segment_bytes=_SEGMENT_BYTES)
+                _check(post["decodable"] is True and not post["corrupt"]
+                       and not post["missing"], cfg,
+                       "archive not fully healthy after repair")
+            else:
+                for op_name, call in (
+                    ("auto_decode", lambda: api.auto_decode_file(
+                        fname, fname + ".dec",
+                        segment_bytes=_SEGMENT_BYTES)),
+                    ("repair", lambda: api.repair_file(
+                        fname, segment_bytes=_SEGMENT_BYTES)),
+                ):
+                    try:
+                        call()
+                        _check(False, cfg,
+                               f"{op_name} succeeded on >p damage")
+                    except ValueError:
+                        pass  # includes UndecidedSubset/ChunkIntegrity
+                # Nothing half-rebuilt: surviving chunks stay byte-exact.
+                for c in range(k + p):
+                    if c in damaged:
+                        continue
+                    got = open(chunk_file_name(fname, c), "rb").read()
+                    _check(got == oracle[c], cfg,
+                           f"survivor chunk {c} mutated by a failed repair")
+        ok = True
+    except ChaosFailure:
+        raise
+    except Exception as e:
+        raise ChaosFailure(
+            cfg, f"unexpected {type(e).__name__}: {e}"
+        ) from e
+    finally:
+        verdict = "pass" if ok else "fail"
+        _metrics.counter(
+            "rs_chaos_iterations_total", "chaos-harness iteration verdicts"
+        ).labels(verdict=verdict).inc()
+        if _runlog.enabled():
+            _runlog.record({
+                "op": "chaos_iter",
+                "config": {"k": k, "n": k + p, "w": w},
+                "bytes": size,
+                "chaos": {
+                    "seed": seed, "iter": i, "events": cfg["events"],
+                    "faults": cfg["faults"],
+                },
+                "outcome": "ok" if ok else "error",
+            })
+        if ok and not keep:
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "iter": i, "k": k, "p": p, "w": w, "size": size,
+        "damaged": sorted({ev["chunk"] for ev in cfg["events"]}),
+        "faults": cfg["faults"], "verdict": "pass",
+    }
+
+
+# -- shrinking ----------------------------------------------------------------
+
+
+def shrink(cfg: dict, workdir: str, run=run_iteration) -> dict:
+    """Greedy one-line-reproducer shrink: drop the fault plan, then each
+    schedule event, keeping any removal that still fails.  Bounded at
+    one pass over the elements (len(events)+1 reruns)."""
+    current = dict(cfg)
+    if current.get("faults"):
+        trial = {**current, "faults": ""}
+        if _still_fails(trial, workdir, run):
+            current = trial
+    events = list(current["events"])
+    idx = 0
+    while idx < len(events):
+        trial_events = events[:idx] + events[idx + 1:]
+        trial = {**current, "events": trial_events}
+        if _still_fails(trial, workdir, run):
+            events = trial_events
+        else:
+            idx += 1
+    current["events"] = events
+    return current
+
+
+def _still_fails(cfg: dict, workdir: str, run) -> bool:
+    try:
+        run(cfg, workdir)
+        return False
+    except ChaosFailure:
+        return True
+
+
+# -- CLI ----------------------------------------------------------------------
+
+
+def _digest(obj) -> str:
+    import hashlib
+
+    return hashlib.sha256(
+        json.dumps(obj, sort_keys=True).encode()
+    ).hexdigest()[:16]
+
+
+def main(argv: list[str] | None = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="rs chaos",
+        description="Seeded chaos harness: encode -> corrupt -> "
+        "scrub/auto-decode/repair, differential-checked against the "
+        "native oracle.  Bit-reproducible per --seed.",
+    )
+    ap.add_argument("--seed", type=int, default=0,
+                    help="master seed (default 0)")
+    ap.add_argument("--iters", type=int, default=10,
+                    help="iterations to run (default 10)")
+    ap.add_argument("--only", type=int, default=None, metavar="I",
+                    help="run just iteration I of the seed's schedule")
+    ap.add_argument("--repro", metavar="JSON", default=None,
+                    help="replay one REPRODUCE line's config verbatim")
+    ap.add_argument("--dir", default=None,
+                    help="work directory (default: a fresh temp dir)")
+    ap.add_argument("--max-bytes", type=int, default=49152,
+                    help="max file size per iteration (default 48 KiB)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON line per iteration")
+    ap.add_argument("--keep", action="store_true",
+                    help="keep every iteration's files on disk")
+    ap.add_argument("--no-shrink", action="store_true",
+                    help="report the failing iteration without minimizing")
+    ap.add_argument("--repro-out", metavar="PATH", default=None,
+                    help="also write the REPRODUCE line to PATH on failure")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    workdir = args.dir or tempfile.mkdtemp(prefix="rs_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    if args.repro:
+        try:
+            cfgs = [json.loads(args.repro)]
+        except ValueError as e:
+            print(f"rs chaos: bad --repro JSON: {e}", file=sys.stderr)
+            return 2
+    else:
+        indices = [args.only] if args.only is not None else range(args.iters)
+        cfgs = [
+            plan_iteration(args.seed, i, args.max_bytes) for i in indices
+        ]
+    schedule_digest = _digest(cfgs)
+
+    results = []
+    for cfg in cfgs:
+        try:
+            rec = run_iteration(cfg, workdir, keep=args.keep)
+        except ChaosFailure as e:
+            shrunk = (
+                e.cfg if args.no_shrink else shrink(e.cfg, workdir)
+            )
+            line = json.dumps(shrunk, sort_keys=True)
+            print(f"rs chaos: FAILED — {e.what}", file=sys.stderr)
+            print(
+                f"rs chaos: replay the original with: rs chaos "
+                f"--seed {cfg['seed']} --only {cfg['iter']}",
+                file=sys.stderr,
+            )
+            print(f"REPRODUCE: {line}")
+            if args.repro_out:
+                with open(args.repro_out, "w") as fp:
+                    fp.write(line + "\n")
+            return 1
+        results.append(rec)
+        if args.json:
+            print(json.dumps(rec, sort_keys=True))
+    print(json.dumps({
+        "seed": args.seed,
+        "iters": len(results),
+        "passed": len(results),
+        "failed": 0,
+        "schedule_digest": schedule_digest,
+        "verdict_digest": _digest(results),
+    }, sort_keys=True))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

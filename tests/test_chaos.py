@@ -1,0 +1,143 @@
+"""Chaos harness tests: bit-reproducibility, differential soundness on a
+small seeded run, shrink minimization, and the CLI surface."""
+
+import json
+
+import pytest
+
+from gpu_rscode_tpu.resilience import chaos, retry
+
+
+@pytest.fixture(autouse=True)
+def fresh_budget():
+    retry.reset_budget()
+    yield
+    retry.reset_budget()
+
+
+def test_schedule_is_pure_function_of_seed():
+    a = [chaos.plan_iteration(42, i) for i in range(10)]
+    b = [chaos.plan_iteration(42, i) for i in range(10)]
+    c = [chaos.plan_iteration(43, i) for i in range(10)]
+    assert a == b
+    assert a != c
+    # iteration independence: --only I replays exactly
+    assert chaos.plan_iteration(42, 7) == a[7]
+
+
+def test_seeded_run_reproducible_and_clean(tmp_path):
+    """The acceptance loop in miniature: the same seed yields the same
+    schedule and the same verdicts twice in a row, with zero differential
+    mismatches."""
+
+    def run(sub):
+        wd = str(tmp_path / sub)
+        return [
+            chaos.run_iteration(chaos.plan_iteration(11, i), wd)
+            for i in range(4)
+        ]
+
+    first = run("a")
+    second = run("b")
+    assert first == second
+    assert all(r["verdict"] == "pass" for r in first)
+
+
+def test_known_failure_is_caught_and_shrunk(tmp_path):
+    """A config that must fail (impossible chunk index -> unexpected
+    error) is caught as ChaosFailure and shrunk to the minimal event."""
+    cfg = {
+        "seed": 1, "iter": 0, "k": 3, "p": 1, "w": 8, "size": 4000,
+        "events": [
+            {"kind": "unlink", "chunk": 0},
+            {"kind": "unlink", "chunk": 9},   # out of range: always fails
+        ],
+        "faults": "",
+    }
+    with pytest.raises(chaos.ChaosFailure):
+        chaos.run_iteration(cfg, str(tmp_path / "run"))
+    shrunk = chaos.shrink(cfg, str(tmp_path / "shrink"))
+    assert shrunk["events"] == [{"kind": "unlink", "chunk": 9}]
+    assert shrunk["faults"] == ""
+
+
+def test_shrink_drops_irrelevant_fault_plan(tmp_path):
+    cfg = {
+        "seed": 2, "iter": 0, "k": 2, "p": 1, "w": 8, "size": 2000,
+        "events": [{"kind": "unlink", "chunk": 5}],
+        "faults": "read:delay@ms=1,p=0.01",
+    }
+    shrunk = chaos.shrink(cfg, str(tmp_path / "s"))
+    assert shrunk["faults"] == ""
+    assert shrunk["events"] == [{"kind": "unlink", "chunk": 5}]
+
+
+def test_unrecoverable_damage_expected(tmp_path):
+    """Overkill damage (> p chunks) must be verified as a clean refusal,
+    not a failure of the harness."""
+    cfg = {
+        "seed": 3, "iter": 0, "k": 3, "p": 1, "w": 8, "size": 6000,
+        "events": [
+            {"kind": "unlink", "chunk": 0},
+            {"kind": "torn", "chunk": 2, "keep_frac": 0.5},
+        ],
+        "faults": "",
+    }
+    rec = chaos.run_iteration(cfg, str(tmp_path / "run"))
+    assert rec["verdict"] == "pass"
+    assert rec["damaged"] == [0, 2]
+
+
+def test_cli_pass_and_only(tmp_path, capsys):
+    rc = chaos.main([
+        "--seed", "11", "--iters", "2", "--dir", str(tmp_path / "w"),
+        "--json",
+    ])
+    out = capsys.readouterr().out.strip().splitlines()
+    assert rc == 0
+    summary = json.loads(out[-1])
+    assert summary["passed"] == 2 and summary["failed"] == 0
+
+    rc = chaos.main([
+        "--seed", "11", "--only", "1", "--dir", str(tmp_path / "w2"),
+    ])
+    assert rc == 0
+    only = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert only["iters"] == 1
+
+
+def test_cli_failure_emits_reproduce_line(tmp_path, capsys):
+    bad = json.dumps({
+        "seed": 1, "iter": 0, "k": 2, "p": 1, "w": 8, "size": 1000,
+        "events": [{"kind": "unlink", "chunk": 8}],
+        "faults": "",
+    })
+    repro_out = str(tmp_path / "repro.txt")
+    rc = chaos.main([
+        "--repro", bad, "--dir", str(tmp_path / "w"),
+        "--repro-out", repro_out,
+    ])
+    captured = capsys.readouterr()
+    assert rc == 1
+    line = next(
+        ln for ln in captured.out.splitlines()
+        if ln.startswith("REPRODUCE: ")
+    )
+    replay = json.loads(line[len("REPRODUCE: "):])
+    assert replay["events"] == [{"kind": "unlink", "chunk": 8}]
+    assert open(repro_out).read().strip() == line[len("REPRODUCE: "):]
+
+
+def test_cli_rejects_bad_repro_json(tmp_path):
+    assert chaos.main(["--repro", "{not json", "--dir", str(tmp_path)]) == 2
+
+
+def test_chaos_subcommand_routes_through_rs_cli(tmp_path, capsys):
+    from gpu_rscode_tpu import cli
+
+    rc = cli.main([
+        "chaos", "--seed", "11", "--iters", "1",
+        "--dir", str(tmp_path / "w"),
+    ])
+    assert rc == 0
+    assert "schedule_digest" in capsys.readouterr().out

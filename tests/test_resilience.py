@@ -1,0 +1,462 @@
+"""Resilience subsystem tests: the deterministic fault plane, the retry
+policy, TOCTOU-window handling, degraded (reselecting) decode and the
+zero-overhead guarantee when everything is disabled."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api
+from gpu_rscode_tpu.obs import metrics
+from gpu_rscode_tpu.resilience import faults, retry
+from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+
+@pytest.fixture
+def clean_registry():
+    metrics.REGISTRY.reset()
+    yield metrics.REGISTRY
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+@pytest.fixture(autouse=True)
+def fresh_budget():
+    retry.reset_budget()
+    yield
+    retry.reset_budget()
+
+
+def _mkfile(tmp_path, size, seed=0, name="f.bin"):
+    path = str(tmp_path / name)
+    rng = np.random.default_rng(seed)
+    open(path, "wb").write(
+        rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    )
+    return path
+
+
+# -- fault spec grammar -------------------------------------------------------
+
+
+def test_fault_spec_parsing():
+    plan = faults.parse_plan(
+        "read:ioerror@p=0.02;chunk2:bitrot@count=8;"
+        "write:torn@after=1MiB;read:delay@ms=50", seed=1,
+    )
+    kinds = {s.kind for s in plan.specs}
+    assert kinds == {"ioerror", "bitrot", "torn", "delay"}
+    torn = next(s for s in plan.specs if s.kind == "torn")
+    assert torn.params["after"] == 1024 * 1024
+    chunk = next(s for s in plan.specs if s.chunk is not None)
+    assert chunk.chunk == 2 and chunk.params["count"] == 8
+
+
+@pytest.mark.parametrize("bad", [
+    "read",                      # no kind
+    "read:explode",              # unknown kind
+    "bogus:ioerror",             # unknown scope
+    "read:ioerror@p=2",          # probability out of range
+    "read:delay",                # delay without ms
+    "write:torn",                # torn without after
+    "read:torn@after=1",         # torn is write-only
+    "write:bitrot@count=1",      # bitrot is read-side
+    "read:ioerror@wibble=1",     # unknown param
+    "chunkX:ioerror",            # bad chunk index
+    "chunk1:ioerror@scope=write",  # bad boundary pin
+    "",                          # empty
+])
+def test_bad_fault_specs_raise(bad):
+    with pytest.raises(ValueError):
+        faults.parse_plan(bad)
+
+
+def test_size_suffixes():
+    plan = faults.parse_plan("write:torn@after=512KiB")
+    assert plan.specs[0].params["after"] == 512 * 1024
+
+
+def test_fault_plan_deterministic():
+    """Same seed, same call sequence -> identical decisions; a different
+    seed diverges.  Targets key by basename, so the directory is free."""
+
+    def decisions(seed, prefix):
+        plan = faults.parse_plan("read:ioerror@p=0.3", seed=seed)
+        out = []
+        for n in range(50):
+            try:
+                plan.on_read(f"{prefix}/_0_f.bin")
+                out.append(0)
+            except faults.InjectedReadError:
+                out.append(1)
+        return out
+
+    a = decisions(7, "/tmp/dirA")
+    b = decisions(7, "/some/other/place")
+    c = decisions(8, "/tmp/dirA")
+    assert a == b
+    assert a != c
+    assert sum(a) > 0  # p=0.3 over 50 draws fires
+
+
+def test_chunk_scope_and_from_and_times():
+    plan = faults.parse_plan("chunk3:ioerror@from=2,times=2", seed=0)
+    # chunk scope only fires for index 3
+    plan.on_read("_1_f.bin", index=1)
+    # first call on chunk 3 is below from=2
+    plan.on_read("_3_f.bin", index=3)
+    with pytest.raises(faults.InjectedReadError):
+        plan.on_read("_3_f.bin", index=3)
+    with pytest.raises(faults.InjectedReadError):
+        plan.on_read("_3_f.bin", index=3)
+    # times=2 exhausted
+    plan.on_read("_3_f.bin", index=3)
+    assert plan.injected[("ioerror", "read")] == 2
+
+
+def test_scope_pin_restricts_boundary():
+    plan = faults.parse_plan("chunk0:ioerror@scope=read", seed=0)
+    plan.on_read("_0_f.bin", index=0, scope="scrub")  # pinned away
+    with pytest.raises(faults.InjectedReadError):
+        plan.on_read("_0_f.bin", index=0, scope="read")
+
+
+def test_torn_write_fires_past_threshold():
+    plan = faults.parse_plan("write:torn@after=100", seed=0)
+    plan.on_write("writer-0", 60)
+    plan.on_write("writer-0", 40)  # cumulative == 100: not past yet
+    with pytest.raises(faults.InjectedWriteError) as ei:
+        plan.on_write("writer-0", 1)
+    assert ei.value.transient is False
+    # and it stays dead
+    with pytest.raises(faults.InjectedWriteError):
+        plan.on_write("writer-0", 0)
+
+
+def test_bitrot_corrupts_copy_not_source():
+    plan = faults.parse_plan("chunk1:bitrot@count=4", seed=3)
+    src = np.zeros(64, dtype=np.uint8)
+    out = plan.corrupt_read("_1_f.bin", 1, src)
+    assert out is not src
+    assert np.count_nonzero(out) > 0
+    assert not src.any()
+    # non-matching chunk passes through untouched, same object
+    assert plan.corrupt_read("_0_f.bin", 0, src) is src
+
+
+# -- the zero-overhead guard (like the disabled-metrics guard) ----------------
+
+
+def test_disabled_fault_plane_is_noop(tmp_path, monkeypatch):
+    """With RS_FAULTS unset, the hooks are the shared no-op: active() is
+    None, nothing ever parses, and a full encode/decode round-trip never
+    touches FaultPlan."""
+    monkeypatch.delenv("RS_FAULTS", raising=False)
+
+    def boom(*a, **k):  # any parse attempt is a failure of the guard
+        raise AssertionError("fault plan parsed with RS_FAULTS unset")
+
+    monkeypatch.setattr(faults, "parse_plan", boom)
+    assert faults.active() is None
+    assert faults.on_read("x") is None
+    assert faults.on_write("lane", 123) is None
+    arr = np.arange(4, dtype=np.uint8)
+    assert faults.corrupt("x", 0, arr) is arr
+    path = _mkfile(tmp_path, 4096)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 3, 2, checksums=True)
+    out = api.auto_decode_file(path, str(tmp_path / "o"))
+    assert open(out, "rb").read() == orig
+
+
+def test_env_plan_cached_and_reparsed_on_change(monkeypatch):
+    monkeypatch.setenv("RS_FAULTS", "read:delay@ms=1")
+    p1 = faults.active()
+    assert p1 is faults.active()  # cached, same object
+    monkeypatch.setenv("RS_FAULTS", "read:delay@ms=2")
+    p2 = faults.active()
+    assert p2 is not p1 and p2.specs[0].params["ms"] == 2.0
+
+
+# -- retry policy -------------------------------------------------------------
+
+
+def test_retry_classification():
+    assert retry.is_transient(faults.InjectedReadError("ioerror", "read", "x"))
+    assert not retry.is_transient(
+        faults.InjectedWriteError("torn", "write", "l", transient=False)
+    )
+    assert retry.is_transient(OSError(5, "EIO"))       # errno.EIO
+    assert retry.is_transient(TimeoutError())
+    assert not retry.is_transient(FileNotFoundError())
+    assert not retry.is_transient(PermissionError())
+    assert not retry.is_transient(ValueError("x"))
+    assert not retry.is_transient(api.ChunkIntegrityError({0: "p"}))
+
+
+def test_retry_recovers_then_exhausts(clean_registry):
+    metrics.force_enable()
+    pol = retry.RetryPolicy(retries=3, base_ms=0.01, max_ms=0.05, seed=1)
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(5, "EIO")
+        return "ok"
+
+    assert pol.call(flaky, op="t") == "ok"
+    assert calls["n"] == 3
+
+    def always():
+        raise OSError(5, "EIO")
+
+    with pytest.raises(OSError):
+        pol.call(always, op="t")
+    snap = metrics.REGISTRY.snapshot()["rs_retries_total"]["values"]
+    assert snap['{outcome="recovered"}'] == 1
+    assert snap['{outcome="exhausted"}'] == 1
+    assert snap['{outcome="retried"}'] >= 2 + 3
+
+
+def test_retry_fatal_passes_straight_through():
+    pol = retry.RetryPolicy(retries=5, base_ms=0.01)
+    calls = {"n": 0}
+
+    def fatal():
+        calls["n"] += 1
+        raise FileNotFoundError("gone")
+
+    with pytest.raises(FileNotFoundError):
+        pol.call(fatal)
+    assert calls["n"] == 1  # no retry burned on a fatal error
+
+
+def test_retry_budget_bounds_process_retries(monkeypatch):
+    monkeypatch.setenv("RS_RETRY_BUDGET", "2")
+    retry.reset_budget()
+    pol = retry.RetryPolicy(retries=10, base_ms=0.01)
+
+    def always():
+        raise OSError(5, "EIO")
+
+    with pytest.raises(OSError):
+        pol.call(always)
+    assert retry.budget_left() == 0
+
+
+def test_backoff_is_seeded_and_bounded():
+    a = retry.RetryPolicy(retries=3, base_ms=4, max_ms=16, seed=5)
+    b = retry.RetryPolicy(retries=3, base_ms=4, max_ms=16, seed=5)
+    da = [a.backoff_s("op", i) for i in range(4)]
+    db = [b.backoff_s("op", i) for i in range(4)]
+    assert da == db
+    assert all(0.002 <= d <= 0.024 for d in da)  # [0.5, 1.5) x clamp
+
+
+# -- I/O-boundary integration -------------------------------------------------
+
+
+def test_injected_read_faults_are_retried_through(tmp_path, monkeypatch):
+    """A flaky (p<1) read plane is survived transparently by retries:
+    decode output stays byte-exact."""
+    path = _mkfile(tmp_path, 20000, seed=1)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2, checksums=True, segment_bytes=4096)
+    monkeypatch.setenv("RS_FAULTS", "read:ioerror@p=0.2")
+    monkeypatch.setenv("RS_FAULTS_SEED", "3")
+    monkeypatch.setenv("RS_RETRY_BASE_MS", "1")
+    out = api.auto_decode_file(path, str(tmp_path / "o"),
+                               segment_bytes=4096)
+    assert open(out, "rb").read() == orig
+
+
+def test_torn_write_aborts_encode_cleanly(tmp_path, monkeypatch):
+    """A write lane that dies mid-stream fails the encode loudly AND
+    atomically: no chunk files, no .rs_tmp litter."""
+    path = _mkfile(tmp_path, 300000, seed=2, name="torn.bin")
+    monkeypatch.setenv("RS_FAULTS", "write:torn@after=64KiB")
+    monkeypatch.setenv("RS_IO_WRITERS", "1")
+    with pytest.raises(OSError):
+        api.encode_file(path, 4, 2, checksums=True, segment_bytes=16384)
+    litter = [f for f in os.listdir(tmp_path) if f != "torn.bin"]
+    assert litter == [], litter
+    monkeypatch.delenv("RS_FAULTS")
+    # the archive encodes fine once the fault is gone
+    api.encode_file(path, 4, 2, checksums=True, segment_bytes=16384)
+
+
+def test_scrub_degraded_read_marks_chunk_bad(tmp_path, monkeypatch):
+    """An unreadable-after-retries chunk is damage for the scan to record,
+    not a reason to fail the whole scrub."""
+    path = _mkfile(tmp_path, 9000, seed=3)
+    api.encode_file(path, 3, 2, checksums=True)
+    monkeypatch.setenv("RS_FAULTS", "chunk1:ioerror@scope=scrub")
+    monkeypatch.setenv("RS_RETRY_BASE_MS", "1")
+    report = api.scan_file(path)
+    assert 1 in report["corrupt"]
+    assert report["decodable"] is True  # 4 healthy of k=3 remain
+
+
+# -- TOCTOU + degraded decode -------------------------------------------------
+
+
+def test_toctou_truncation_names_chunk(tmp_path):
+    """A chunk truncated between scan/conf and decode raises
+    ChunkIntegrityError naming the index — not a raw ValueError."""
+    path = _mkfile(tmp_path, 10000, seed=4)
+    api.encode_file(path, 4, 2, checksums=True)
+    conf = path + ".conf"
+    with open(conf, "w") as fp:
+        fp.write("".join(f"_{i}_f.bin\n" for i in range(4)))
+    victim = chunk_file_name(path, 2)
+    with open(victim, "r+b") as fp:
+        fp.truncate(10)
+    with pytest.raises(api.ChunkIntegrityError) as ei:
+        api.decode_file(path, conf, str(tmp_path / "o"),
+                        verify_checksums=False)
+    assert 2 in ei.value.bad_chunks
+
+
+def test_toctou_unlink_names_chunk_not_raw_oserror(tmp_path, monkeypatch):
+    """A chunk that vanishes between resolve and open lands in the same
+    ChunkIntegrityError bucket (simulated via an injected open fault —
+    the unlink race itself is a few-ns window)."""
+    path = _mkfile(tmp_path, 10000, seed=4)
+    api.encode_file(path, 4, 2, checksums=True)
+    conf = path + ".conf"
+    with open(conf, "w") as fp:
+        fp.write("".join(f"_{i}_f.bin\n" for i in range(4)))
+    monkeypatch.setenv("RS_FAULTS", "chunk1:ioerror@scope=read")
+    monkeypatch.setenv("RS_RETRY_BASE_MS", "1")
+    with pytest.raises(api.ChunkIntegrityError) as ei:
+        api.decode_file(path, conf, str(tmp_path / "o"),
+                        verify_checksums=False)
+    assert 1 in ei.value.bad_chunks
+
+
+def test_auto_decode_recovers_from_toctou(tmp_path, clean_registry):
+    """auto_decode_file excludes a post-scan-truncated survivor and
+    reselects — the degraded-read loop end to end."""
+    metrics.force_enable()
+    path = _mkfile(tmp_path, 20000, seed=5)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 4, 2, checksums=True)
+
+    # Sabotage the scan: after it CRC-verifies, truncate a native the
+    # selection will certainly have chosen (natives-first).
+    real_scan = api._scan_chunks
+    state = {"done": False}
+
+    def scan_then_truncate(in_file, segment_bytes):
+        scan = real_scan(in_file, segment_bytes)
+        if not state["done"]:
+            state["done"] = True
+            with open(chunk_file_name(path, 0), "r+b") as fp:
+                fp.truncate(7)
+        return scan
+
+    try:
+        api._scan_chunks = scan_then_truncate
+        out = api.auto_decode_file(path, str(tmp_path / "o"))
+    finally:
+        api._scan_chunks = real_scan
+    assert open(out, "rb").read() == orig
+    snap = metrics.REGISTRY.snapshot()["rs_degraded_decodes_total"]["values"]
+    assert snap['{stage="reselect"}'] == 1
+
+
+def test_midstream_failure_reselects_and_resumes(tmp_path, clean_registry,
+                                                 monkeypatch):
+    """A survivor that starts erroring mid-stream (open fine, gathers
+    failing past their retries) is swapped for a fallback chunk and the
+    decode resumes — output byte-exact, rs_degraded_decodes counted."""
+    metrics.force_enable()
+    path = _mkfile(tmp_path, 64000, seed=6)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 3, 2, checksums=True, segment_bytes=4096)
+    os.unlink(chunk_file_name(path, 0))  # force a recovery decode
+    monkeypatch.setenv("RS_FAULTS", "chunk1:ioerror@from=2,scope=read")
+    monkeypatch.setenv("RS_FAULTS_SEED", "0")
+    monkeypatch.setenv("RS_RETRY_BASE_MS", "1")
+    out = api.auto_decode_file(path, str(tmp_path / "o"),
+                               segment_bytes=4096)
+    assert open(out, "rb").read() == orig
+    snap = metrics.REGISTRY.snapshot()
+    vals = snap["rs_degraded_decodes_total"]["values"]
+    assert vals['{stage="midstream"}'] == 1
+    assert snap["rs_faults_injected_total"]["values"][
+        '{kind="ioerror",scope="read"}'
+    ] >= 1
+
+
+def test_midstream_failure_without_pool_names_chunk(tmp_path, monkeypatch):
+    """Plain decode_file (no fallback pool) cannot swap survivors, but a
+    mid-stream failure past its retries still surfaces as
+    ChunkIntegrityError NAMING the survivor — the same contract as an
+    open-time failure, so callers can build a better conf."""
+    path = _mkfile(tmp_path, 32000, seed=7)
+    api.encode_file(path, 3, 2, checksums=True, segment_bytes=4096)
+    os.unlink(chunk_file_name(path, 0))
+    conf = path + ".conf"
+    with open(conf, "w") as fp:
+        fp.write("_1_f.bin\n_2_f.bin\n_3_f.bin\n")
+    monkeypatch.setenv("RS_FAULTS", "chunk1:ioerror@from=2,scope=read")
+    # Distinct seed: the env-plan cache keys on (text, seed), and the
+    # previous test's plan for this text has already-advanced counters.
+    monkeypatch.setenv("RS_FAULTS_SEED", "9")
+    monkeypatch.setenv("RS_RETRY_BASE_MS", "1")
+    with pytest.raises(api.ChunkIntegrityError) as ei:
+        api.decode_file(path, conf, str(tmp_path / "o"),
+                        verify_checksums=False, segment_bytes=4096)
+    assert list(ei.value.bad_chunks) == [1]
+
+
+# -- subset-search retry (the singular-minor discipline surfaced) -------------
+
+
+def test_select_subset_skip_and_cap_window():
+    """skip/cap window the candidate stream so retry batches continue the
+    search instead of redoing it."""
+    from gpu_rscode_tpu.ops.gf import get_field
+
+    # k=2 with the first several healthy rows identical: every subset
+    # drawn from them is singular; a later distinct row pairs invertibly.
+    k, w = 2, 8
+    rows = [[1, 1]] * 6 + [[1, 2], [1, 3]]
+    total = np.array(rows, dtype=np.uint8)
+    scan = api._ChunkScan(
+        "f", 10, len(rows) - k, k, total, w, {}, 5,
+        healthy=list(range(len(rows))), bad={},
+    )
+    with pytest.raises(api.UndecidedSubsetError):
+        api._select_decodable_subset(scan, cap=5, skip=0)
+    # the windowed continuation finds the decodable pair
+    chosen, inv = api._select_subset_retrying(scan, attempts=40)
+    gf = get_field(w)
+    assert np.array_equal(
+        gf.matmul(total[chosen].astype(gf.dtype), inv),
+        np.eye(k, dtype=gf.dtype),
+    )
+
+
+def test_auto_decode_survives_undecided_first_batch(tmp_path, monkeypatch):
+    """auto_decode_file retries past an UndecidedSubsetError batch instead
+    of propagating it."""
+    path = _mkfile(tmp_path, 8000, seed=8)
+    orig = open(path, "rb").read()
+    api.encode_file(path, 3, 2, checksums=True)
+    real = api._select_decodable_subset
+    calls = {"n": 0}
+
+    def flaky_select(scan, *, cap=100, skip=0):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise api.UndecidedSubsetError("synthetic cap hit")
+        return real(scan, cap=cap, skip=0)
+
+    monkeypatch.setattr(api, "_select_decodable_subset", flaky_select)
+    out = api.auto_decode_file(path, str(tmp_path / "o"))
+    assert open(out, "rb").read() == orig
+    assert calls["n"] == 2
