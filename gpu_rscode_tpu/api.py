@@ -24,6 +24,7 @@ from __future__ import annotations
 import functools
 import inspect
 import os
+import threading
 import time
 
 import numpy as np
@@ -31,8 +32,8 @@ import numpy as np
 from contextlib import contextmanager, nullcontext
 
 from .codec import RSCodec
-from .obs import metrics as _obs_metrics, runlog as _obs_runlog, \
-    tracing as _obs_tracing
+from .obs import attrib as _obs_attrib, metrics as _obs_metrics, \
+    runlog as _obs_runlog, tracing as _obs_tracing
 from .parallel.io_executor import DrainExecutor, FleetPipeline
 from .parallel.pipeline import AsyncWindow, DeviceStagingRing, SegmentPrefetcher
 from .resilience import faults as _faults, retry as _retry
@@ -86,6 +87,58 @@ class ChunkIntegrityError(ValueError):
 DEFAULT_SEGMENT_BYTES = 64 * 1024 * 1024
 
 
+# -- deep-profiling hook (RS_PROFILE) ----------------------------------------
+#
+# jax.profiler capture used to be a CLI-only wrapper around encode/decode
+# (cli.py's --profile-dir); lifting it here puts EVERY file-level entry
+# point — scrub, repair, fleet, chaos recovery loops, library callers —
+# under the same deep-profiling surface.  RS_PROFILE=<dir> (or the CLI
+# flag, now an alias that latches profile_dir_override) wraps the
+# OUTERMOST observed operation in jax.profiler.trace(dir); nested entry
+# points (auto_decode -> decode, fleet -> repair) join the active capture
+# instead of re-entering the profiler.
+
+_PROFILE_LOCK = threading.Lock()
+_PROFILE_ACTIVE = False
+_PROFILE_DIR_OVERRIDE: str | None = None
+
+
+def profile_dir_override(profile_dir: str | None) -> None:
+    """Latch a capture directory for this process regardless of
+    ``RS_PROFILE`` — the in-process equivalent of exporting the env var
+    (the CLI's deprecated ``--profile-dir`` alias routes through this
+    instead of wrapping the operation itself).  Pass None to clear."""
+    global _PROFILE_DIR_OVERRIDE
+    _PROFILE_DIR_OVERRIDE = profile_dir
+
+
+@contextmanager
+def _profile_session():
+    """jax.profiler capture for one outermost file operation (no-op when
+    RS_PROFILE is unset and no override is latched; nested operations
+    record into the outer capture)."""
+    profile_dir = _PROFILE_DIR_OVERRIDE or os.environ.get("RS_PROFILE")
+    if not profile_dir:
+        yield
+        return
+    global _PROFILE_ACTIVE
+    with _PROFILE_LOCK:
+        owner = not _PROFILE_ACTIVE
+        if owner:
+            _PROFILE_ACTIVE = True
+    if not owner:
+        yield
+        return
+    try:
+        import jax
+
+        with jax.profiler.trace(profile_dir):
+            yield
+    finally:
+        with _PROFILE_LOCK:
+            _PROFILE_ACTIVE = False
+
+
 def _observed_file_op(op: str):
     """Wrap a file-level entry point with the unified observability surface
     (docs/OBSERVABILITY.md): every wrapped function accepts an extra
@@ -124,7 +177,7 @@ def _observed_file_op(op: str):
             )
             error: BaseException | None = None
             try:
-                with _obs_tracing.session(trace_path):
+                with _profile_session(), _obs_tracing.session(trace_path):
                     with _obs_tracing.span(op, lane="op"):
                         out = fn(*args, **kwargs)
             except BaseException as e:
@@ -143,6 +196,14 @@ def _observed_file_op(op: str):
             _obs_metrics.counter(
                 "rs_file_ops_total", "file-level operations completed"
             ).labels(op=op).inc()
+            # Tail latency of the whole operation (p50/p99 next to the
+            # mean the ledger already trends) — successes only; failures
+            # are counted by outcome in the ledger, and mixing their
+            # walls into the latency series would skew the percentiles.
+            _obs_metrics.quantile(
+                "rs_file_op_wall_seconds",
+                "file-level operation wall seconds (streaming quantiles)",
+            ).labels(op=op).observe(time.perf_counter() - t0)
             return out
 
         return wrapper
@@ -240,12 +301,25 @@ def _drain_ctx(fleet: FleetPipeline | None, *, ordered: bool = True):
     return DrainExecutor(ordered=ordered)
 
 
+@contextmanager
 def _dispatch_span(op: str, off: int, cols: int):
     """Per-segment dispatch span (one per dispatched segment, with its
-    column range in args — the trace's unit of accountability)."""
-    return _obs_tracing.span(
+    column range in args — the trace's unit of accountability).  Also
+    feeds the dispatch tail-latency quantiles (`rs analyze` reads the
+    p50/p99 split to tell dispatch-bound strategies from memory-bound
+    ones) and samples device memory at the segment boundary — this is
+    the ONE per-segment sampling site (all six dispatch loops, mesh
+    included, pass through here)."""
+    t0 = time.perf_counter()
+    with _obs_tracing.span(
         "dispatch", lane="dispatch", op=op, off=int(off), cols=int(cols)
-    )
+    ):
+        yield
+    _obs_metrics.quantile(
+        "rs_dispatch_wall_seconds",
+        "per-segment dispatch wall seconds (streaming quantiles)",
+    ).labels(op=op).observe(time.perf_counter() - t0)
+    _obs_attrib.sample_device_memory()
 
 
 def _segment_spans(chunk_size: int, seg_cols: int) -> list[tuple[int, int]]:

@@ -97,6 +97,18 @@ Subcommands: rs stats [--text] [--workload]
             (seeded encode -> corrupt -> scrub/decode/repair loop,
             differential-checked against the native oracle; failures
             shrink to a one-line reproducer)
+            rs analyze [--json] [--strategies S,S] [--k K] [--p P]
+            [--size-kb N] [--refresh-roofline]
+            (roofline attribution: per-strategy achieved GB/s, GFLOP/s,
+            arithmetic intensity and a memory/compute/dispatch bound
+            verdict against the calibrated host roofline)
+            rs doctor [--json]
+            (one-shot environment diagnostic: backend/devices, native
+            lib, mesh sanity, RS_* knobs, ledger/endpoint reachability,
+            roofline freshness)
+            RS_PROFILE=DIR wraps every file operation (scrub/fleet/chaos
+            included) in a jax.profiler capture; --profile-dir is the
+            per-run alias
 """
 
 
@@ -229,13 +241,25 @@ def _history_main(argv: list[str]) -> int:
                 f" {f'{g:.3f}GB/s' if g is not None else '-':>11}"
                 f" {r.get('outcome', '?')}"
             )
+        from .obs.percentile import quantile_of
+
+        walls = [r.get("wall_s") for r in window
+                 if r.get("outcome", "ok") == "ok"
+                 and isinstance(r.get("wall_s"), (int, float))]
         print(
             f"# {len(recs)} records ({errors} errors); window of "
             f"{len(window)}: "
             + (
-                f"mean {statistics.fmean(gbps):.3f} GB/s, "
+                f"mean {statistics.fmean(gbps):.3f} GB/s "
+                f"(p50 {quantile_of(gbps, 0.5):.3f}, "
+                f"p99 {quantile_of(gbps, 0.99):.3f}), "
                 f"best {max(gbps):.3f} GB/s over {len(gbps)} measured"
                 if gbps else "no throughput-measurable records"
+            )
+            + (
+                f"; wall p50 {quantile_of(walls, 0.5):.3f}s "
+                f"p99 {quantile_of(walls, 0.99):.3f}s"
+                if walls else ""
             ),
             file=sys.stderr,
         )
@@ -376,6 +400,14 @@ def main(argv: list[str] | None = None) -> int:
         from .resilience.chaos import main as _chaos_main
 
         return _chaos_main(argv[1:])
+    if argv and argv[0] == "analyze":
+        from .obs.attrib import main as _analyze_main
+
+        return _analyze_main(argv[1:])
+    if argv and argv[0] == "doctor":
+        from .obs.doctor import main as _doctor_main
+
+        return _doctor_main(argv[1:])
     try:
         # gnu_getopt: flags may follow the fleet-repair positional archives
         # (the reference surface has no positionals, so ordering semantics
@@ -658,12 +690,13 @@ def main(argv: list[str] | None = None) -> int:
     obs_serve.maybe_start_from_env()
 
     timer = PhaseTimer(enabled=True)
-    ctx = None
     if profile_dir:
-        import jax
-
-        ctx = jax.profiler.trace(profile_dir)
-        ctx.__enter__()
+        # Deprecated alias for RS_PROFILE=<dir>: the capture itself now
+        # lives in api._observed_file_op (so scrub/fleet/chaos paths and
+        # library callers profile too); the flag just latches the same
+        # override for this run, cleared in the finally below so later
+        # in-process main() calls (tests, embedders) don't inherit it.
+        api.profile_dir_override(profile_dir)
     fault_ctx = None
     if fault_plan is not None:
         from .resilience import faults as _res_faults
@@ -779,8 +812,8 @@ def main(argv: list[str] | None = None) -> int:
     finally:
         if fault_ctx is not None:
             fault_ctx.__exit__(None, None, None)
-        if ctx is not None:
-            ctx.__exit__(None, None, None)
+        if profile_dir:
+            api.profile_dir_override(None)
         # In the finally: the snapshot must land on EVERY exit from the
         # run — success, handled error, unhandled exception (device
         # runtime errors, KeyboardInterrupt on a long encode) or a

@@ -152,6 +152,25 @@ class RSCodec:
             "segments_dispatched",
             "stripe GEMM dispatches by operation and strategy",
         ).labels(op=op, strategy=self.strategy, w=self.w).inc()
+        # Payload volume next to the dispatch count: the per-strategy
+        # byte stream `rs analyze` divides by measured wall for achieved
+        # GB/s.  True (pre-pad) columns for pipeline-staged segments —
+        # bucket pad is compute, not payload.
+        from . import plan as _plan
+
+        if isinstance(data, _plan.StagedSegment):
+            nbytes = (
+                data.array.shape[0] * data.cols * data.array.dtype.itemsize
+            )
+        else:
+            nbytes = getattr(data, "nbytes", 0)
+        if nbytes:
+            _obs_metrics.counter(
+                "rs_codec_bytes_total",
+                "payload bytes entering stripe GEMM dispatches",
+            ).labels(op=op, strategy=self.strategy, w=self.w).inc(
+                int(nbytes)
+            )
 
     def encode(self, data):
         """(k, m) natives -> (p, m) parity.  Systematic: natives pass through

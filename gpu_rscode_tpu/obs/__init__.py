@@ -17,6 +17,13 @@ One instrumentation surface for the whole codebase (docs/OBSERVABILITY.md):
 - :mod:`.serve` — stdlib HTTP exposition: ``/metrics`` (Prometheus
   text), ``/healthz``, ``/runs`` (ledger tail); ``RS_METRICS_PORT`` or
   ``rs serve-metrics`` starts it.
+- :mod:`.percentile` — mergeable fixed-size reservoir quantile
+  estimators backing the ``quantile`` metric kind (tail latency:
+  p50/p90/p99 + exact max).
+- :mod:`.attrib` — kernel-level performance attribution: per-plan
+  ``cost_analysis`` capture, the per-host roofline calibration (cached
+  in the ledger), device-memory sampling, and ``rs analyze``.
+- :mod:`.doctor` — ``rs doctor``, the one-shot environment diagnostic.
 
 All modules are stdlib-only imports (no jax/numpy) so any layer can be
 instrumented without import-cost or backend-init concerns

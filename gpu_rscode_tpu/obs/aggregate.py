@@ -38,6 +38,8 @@ import os
 import re
 import sys
 
+from . import percentile as _percentile
+
 _PART_RE = re.compile(r"\.p(\d+)$")
 
 
@@ -76,6 +78,21 @@ def part_path(base: str, process_index: int, process_count: int) -> str:
 
 def _is_histogram_value(v) -> bool:
     return isinstance(v, dict) and "buckets" in v
+
+
+def _is_quantile_value(v) -> bool:
+    return isinstance(v, dict) and "reservoir" in v
+
+
+def _merge_quantile(acc: dict | None, v: dict) -> dict:
+    """Fold one part's estimator state into the accumulator: exact
+    count/sum/min/max, count-weighted reservoir merge (the
+    obs/percentile.py contract — a part that saw 10x the events
+    contributes ~10x the samples), percentile family recomputed from the
+    merged reservoir."""
+    merged = _percentile.merge_states([acc, v] if acc else [v])
+    merged["quantiles"] = _percentile.state_quantiles(merged)
+    return merged
 
 
 def _merge_histogram(acc: dict | None, v: dict) -> dict:
@@ -132,6 +149,10 @@ def merge_snapshots(snaps: list[dict]) -> dict:
             for label, v in fam.get("values", {}).items():
                 if kind == "histogram" or _is_histogram_value(v):
                     dst["values"][label] = _merge_histogram(
+                        dst["values"].get(label), v
+                    )
+                elif kind == "quantile" or _is_quantile_value(v):
+                    dst["values"][label] = _merge_quantile(
                         dst["values"].get(label), v
                     )
                 elif kind == "gauge":
@@ -232,9 +253,27 @@ def render_text(metrics_snapshot: dict) -> str:
         fam = metrics_snapshot[name]
         if fam.get("help"):
             lines.append(f"# HELP {name} {fam['help']}")
-        lines.append(f"# TYPE {name} {fam.get('type', 'untyped')}")
+        ftype = fam.get("type", "untyped")
+        # Prometheus has no native "quantile" type; the closest scrape
+        # vocabulary is a summary (pre-computed quantile labels).
+        lines.append(
+            f"# TYPE {name} {'summary' if ftype == 'quantile' else ftype}"
+        )
         for label, v in sorted(fam.get("values", {}).items()):
-            if _is_histogram_value(v):
+            if _is_quantile_value(v):
+                inner = label[1:-1] if label else ""
+                sep = "," if inner else ""
+                qs = v.get("quantiles") or _percentile.state_quantiles(v)
+                for q, qv in sorted(qs.items(), key=lambda kv: float(kv[0])):
+                    if qv is not None:
+                        lines.append(
+                            f'{name}{{{inner}{sep}quantile="{q}"}} {qv}'
+                        )
+                lines.append(f"{name}_sum{label} {v.get('sum', 0.0)}")
+                lines.append(f"{name}_count{label} {v.get('count', 0)}")
+                if v.get("max") is not None:
+                    lines.append(f"{name}_max{label} {v['max']}")
+            elif _is_histogram_value(v):
                 inner = label[1:-1] if label else ""
                 sep = "," if inner else ""
                 for le, cum in v["buckets"].items():

@@ -1,0 +1,651 @@
+"""Kernel-level performance attribution — roofline accounting.
+
+The obs stack so far records wall-clock spans and counters but never
+attributes time to FLOPs, bytes moved, or memory bandwidth — so the
+ROADMAP's headline gap (native GF kernel ~6.3 GB/s vs best pure-JAX
+~0.17 GB/s on CPU) is undiagnosable from inside the system: is a
+strategy memory-bound, compute-bound, or dispatch-bound?  This module
+answers that, the way the XOR-EC literature frames it (arXiv 2108.02692,
+arXiv 1909.02871 optimize against measured arithmetic-intensity /
+roofline numbers):
+
+* **Cost capture** — :func:`extract_cost_analysis` pulls
+  ``compiled.cost_analysis()`` (FLOPs, bytes accessed, transcendentals)
+  off every AOT plan executable at build time (plan.py stores it in the
+  plan-cache stats), tolerating backends that return None, lists, or
+  partial key sets.
+* **Machine roofline** — :func:`get_roofline` calibrates the host with a
+  STREAM-style triad (peak memory GB/s) and a GEMM microprobe (peak
+  GFLOP/s), cached per host in the run ledger (``kind: "rs_roofline"``
+  records in ``RS_RUNLOG``) so repeated ``rs analyze`` runs skip the
+  probe until it goes stale (``RS_ROOFLINE_MAX_AGE_S``, default 7 days).
+* **Attribution** — :func:`build_report` combines measured walls,
+  dispatch counts and the per-dispatch cost model into achieved GB/s,
+  achieved GFLOP/s and arithmetic intensity per (strategy, op, k, n, w,
+  backend), then classifies each row against the roofline: ``memory``
+  (approaching the bandwidth roof), ``compute`` (approaching the FLOP
+  roof) or ``dispatch`` (approaching neither — per-dispatch overhead
+  dominates).
+* **Memory hooks** — :func:`sample_device_memory` samples
+  ``device.memory_stats()`` into ``rs_device_mem_bytes{kind}`` gauges at
+  segment boundaries (wired in ``parallel/pipeline.py``).
+
+``rs analyze`` (this module's :func:`main`) runs a small per-strategy
+encode/decode workload through the real file API and prints the
+attribution table (or ``--json`` for the machine-readable report the CI
+analyze-smoke step validates).
+
+Module import cost: stdlib only, like the rest of ``obs/`` — numpy/jax
+load lazily inside the functions that need them.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import sys
+import time
+
+from . import metrics as _metrics, runlog as _runlog
+
+SCHEMA_VERSION = 1
+
+# Cost-analysis keys we persist, XLA name -> normalized name.
+_COST_KEYS = {
+    "flops": "flops",
+    "bytes accessed": "bytes_accessed",
+    "transcendentals": "transcendentals",
+}
+
+_DEFAULT_ROOFLINE_MAX_AGE_S = 7 * 86400.0
+
+# A row is "approaching a roof" when it achieves at least this fraction
+# of the calibrated peak; below it on BOTH roofs, the time went to
+# neither bandwidth nor arithmetic — i.e. dispatch/framework overhead.
+BOUND_THRESHOLD = 0.33
+
+
+def extract_cost_analysis(compiled) -> dict | None:
+    """Best-effort ``compiled.cost_analysis()`` -> normalized dict.
+
+    Backends disagree here: some raise, some return None, some return a
+    list of per-computation dicts, and key sets vary (CPU XLA omits
+    keys a TPU build reports).  Anything unusable degrades to None —
+    attribution then falls back to the analytic cost model; it must
+    never fail the plan build that hosts it.
+    """
+    try:
+        ca = compiled.cost_analysis()
+    except Exception:
+        return None
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        return None
+    out = {}
+    for xla_key, norm in _COST_KEYS.items():
+        v = ca.get(xla_key)
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[norm] = float(v)
+    return out or None
+
+
+def analytic_cost(rows_out: int, k: int, cols: int, sym: int = 1) -> dict:
+    """Textbook GF-GEMM cost of one (rows_out, k) x (k, cols) dispatch:
+    one multiply + one XOR per term, operands read once, output written
+    once.  The fallback when no XLA cost analysis exists (host codec,
+    backends returning None) — and the idealized floor the XLA numbers
+    are compared against (the bitplane path's 8x expansion shows up as a
+    much larger measured ``bytes_accessed``)."""
+    return {
+        "flops": 2.0 * rows_out * k * cols,
+        "bytes_accessed": float(
+            (k * cols + rows_out * cols + rows_out * k) * sym
+        ),
+    }
+
+
+# -- machine roofline --------------------------------------------------------
+
+
+def measure_roofline(reps: int = 3) -> dict:
+    """Calibrate this host: STREAM-style triad GB/s + GEMM GFLOP/s.
+
+    Deliberately cheap (~0.2-0.5 s): best-of-``reps`` over arrays big
+    enough to defeat L2 but small enough to keep ``rs analyze`` snappy.
+    """
+    import numpy as np
+
+    n = 2_000_000  # 16 MB per float64 array
+    b = np.random.default_rng(0).random(n)
+    c = np.random.default_rng(1).random(n)
+    t = np.empty_like(b)
+    a = np.empty_like(b)
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        # NumPy cannot fuse the triad, so credit the passes that ACTUALLY
+        # move: multiply reads c + writes t (2), add reads b + t + writes
+        # a (3) — 5 passes, not STREAM's fused 3.  Crediting 3 here would
+        # understate peak_bw ~40% and push dispatch-bound rows over the
+        # bound threshold into a false "memory" verdict.
+        np.multiply(c, 0.5, out=t)
+        np.add(b, t, out=a)
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+    triad_gbps = 5 * 8 * n / best / 1e9
+
+    dim = 512
+    x = np.random.default_rng(2).random((dim, dim), dtype=np.float32)
+    y = np.random.default_rng(3).random((dim, dim), dtype=np.float32)
+    x @ y  # warm the BLAS path once
+    best = float("inf")
+    for _ in range(max(1, reps)):
+        t0 = time.perf_counter()
+        x @ y
+        dt = time.perf_counter() - t0
+        best = min(best, dt)
+    gemm_gflops = 2.0 * dim**3 / best / 1e9
+    return {
+        "triad_gbps": round(triad_gbps, 3),
+        "gemm_gflops": round(gemm_gflops, 3),
+        "ts": time.time(),
+        "host": socket.gethostname(),
+    }
+
+
+def roofline_max_age_s() -> float:
+    try:
+        return float(
+            os.environ.get("RS_ROOFLINE_MAX_AGE_S",
+                           _DEFAULT_ROOFLINE_MAX_AGE_S)
+        )
+    except ValueError:
+        return _DEFAULT_ROOFLINE_MAX_AGE_S
+
+
+def load_cached_roofline(ledger: str | None = None) -> dict | None:
+    """Most recent ``rs_roofline`` ledger record for THIS host (rooflines
+    are per-machine; a shared-filesystem ledger carries every host's)."""
+    p = ledger or _runlog.path()
+    if not p or not (os.path.exists(p) or os.path.exists(p + ".1")):
+        return None
+    host = socket.gethostname()
+    for rec in reversed(_runlog.read_records(p)):
+        if rec.get("kind") == "rs_roofline" and rec.get("host") == host:
+            return rec
+    return None
+
+
+def get_roofline(
+    ledger: str | None = None, refresh: bool = False
+) -> dict:
+    """The host roofline: ledger-cached when fresh, else probed (and the
+    probe recorded back into the ledger when one is configured)."""
+    if not refresh:
+        cached = load_cached_roofline(ledger)
+        if cached is not None:
+            age = time.time() - float(cached.get("ts") or 0)
+            if 0 <= age < roofline_max_age_s() and \
+                    cached.get("triad_gbps") and cached.get("gemm_gflops"):
+                return dict(cached, source="ledger", age_s=round(age, 1))
+    probe = measure_roofline()
+    p = ledger or _runlog.path()
+    if p:
+        _runlog.append(
+            dict(probe, kind="rs_roofline", schema=SCHEMA_VERSION,
+                 backend=_runlog.backend_name()),
+            ledger_path=p,
+        )
+    return dict(probe, source="probe", age_s=0.0)
+
+
+# -- device memory hooks -----------------------------------------------------
+
+# memory_stats() keys worth a gauge each (CPU backends return None and
+# cost one dict lookup; TPU/GPU report all of these).
+_MEM_KINDS = ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+              "largest_free_block_bytes")
+
+# 1-in-N sampling at segment boundaries: the sampler runs inside the
+# dispatch loop (api._dispatch_span), and a multi-device backend pays one
+# memory_stats() runtime call per device per sample — unthrottled, that
+# overhead lands in the very dispatch walls `rs analyze` attributes.
+_SAMPLE_EVERY = 8
+_sample_tick = [0]
+
+
+def sample_device_memory(force: bool = False) -> None:
+    """Sample ``device.memory_stats()`` into ``rs_device_mem_bytes{kind,
+    device}`` gauges — called at segment boundaries (the dispatch span),
+    so HBM pressure is visible per pipeline step, not just post-mortem.
+    Throttled to 1 in ``_SAMPLE_EVERY`` calls (``force=True`` bypasses);
+    no-op unless RS_METRICS is on AND jax is already imported (this must
+    never force a backend init from an instrumentation site)."""
+    if not _metrics.enabled():
+        return
+    if not force:
+        _sample_tick[0] = (_sample_tick[0] + 1) % _SAMPLE_EVERY
+        if _sample_tick[0] != 1:
+            return
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        for kind in _MEM_KINDS:
+            v = stats.get(kind)
+            if isinstance(v, (int, float)):
+                _metrics.gauge(
+                    "rs_device_mem_bytes",
+                    "device memory_stats() sampled at segment boundaries",
+                ).labels(kind=kind, device=int(getattr(d, "id", 0))).set(
+                    int(v)
+                )
+
+
+# -- attribution workload ----------------------------------------------------
+
+# Default strategy set for `rs analyze`: the two pure-JAX paths whose gap
+# the ROADMAP tracks, plus the native host codec ("native" is the analyze
+# surface's name for the codec's strategy="cpu").
+DEFAULT_STRATEGIES = ("table", "bitplane", "native")
+
+_STRATEGY_ALIASES = {"native": "cpu"}
+
+
+def _counter_value(snapshot: dict, name: str, **labels) -> float:
+    """Sum of a snapshot counter family's series matching ``labels``."""
+    fam = snapshot.get(name) or {}
+    want = {k: str(v) for k, v in labels.items()}
+    total = 0.0
+    for label_str, v in (fam.get("values") or {}).items():
+        if not isinstance(v, (int, float)):
+            continue
+        inner = label_str[1:-1] if label_str else ""
+        have = {}
+        for part in inner.split(","):
+            if "=" in part:
+                kk, vv = part.split("=", 1)
+                have[kk] = vv.strip('"')
+        if all(have.get(k) == val for k, val in want.items()):
+            total += v
+    return total
+
+
+def run_workload(
+    strategies=DEFAULT_STRATEGIES,
+    k: int = 4,
+    p: int = 2,
+    w: int = 8,
+    size: int = 1 << 20,
+    segment_bytes: int = 256 * 1024,
+) -> list[dict]:
+    """Per-strategy encode + decode of one synthetic file through the
+    real file API, warm-measured (one warm-up pass per op absorbs the
+    AOT compiles, then one measured pass with a fresh PhaseTimer).
+
+    Returns one measurement row per (strategy, op) with wall seconds,
+    the dispatch/compute phase split, payload bytes and the dispatch
+    count (from the ``segments_dispatched`` counter delta).  Metrics are
+    force-enabled for the run — the dispatch/file-op percentile series
+    this populates are part of the report — and the latch is RESTORED
+    afterwards, so an in-process embedder calling analyze once does not
+    lose the disabled-path guarantee for the rest of the process.
+    """
+    prev_forced = _metrics.forced()
+    _metrics.force_enable()
+    try:
+        return _run_workload_enabled(
+            strategies, k, p, w, size, segment_bytes
+        )
+    finally:
+        _metrics.force_enable(prev_forced)
+
+
+def _run_workload_enabled(
+    strategies, k: int, p: int, w: int, size: int, segment_bytes: int
+) -> list[dict]:
+    import tempfile
+
+    import numpy as np
+
+    from .. import api
+    from ..tools.make_conf import make_conf
+    from ..utils.timing import PhaseTimer
+
+    rows: list[dict] = []
+    rng = np.random.default_rng(20260804)
+    for name in strategies:
+        strategy = _STRATEGY_ALIASES.get(name, name)
+        with tempfile.TemporaryDirectory(prefix="rs_analyze_") as d:
+            path = os.path.join(d, "payload.bin")
+            with open(path, "wb") as fp:
+                fp.write(rng.integers(0, 256, size=size,
+                                      dtype=np.uint8).tobytes())
+            # decode reads w from .METADATA; only encode takes it.
+            common = dict(strategy=strategy, segment_bytes=segment_bytes)
+            enc = dict(common, w=w)
+
+            def measure(op: str, fn, rows_out: int) -> dict:
+                before = _metrics.REGISTRY.snapshot()
+                timer = PhaseTimer(enabled=True)
+                t0 = time.perf_counter()
+                fn(timer)
+                wall = time.perf_counter() - t0
+                after = _metrics.REGISTRY.snapshot()
+                dispatches = _counter_value(
+                    after, "segments_dispatched",
+                    op=op, strategy=strategy, w=w,
+                ) - _counter_value(
+                    before, "segments_dispatched",
+                    op=op, strategy=strategy, w=w,
+                )
+                phases = timer.phase_report()
+                dispatch_s = phases.get(f"{op} dispatch", 0.0)
+                compute_s = phases.get(f"{op} compute", 0.0)
+                return {
+                    "strategy": name,
+                    "codec_strategy": strategy,
+                    "op": op,
+                    "rows_out": rows_out,
+                    "wall_s": round(wall, 6),
+                    "dispatch_s": round(dispatch_s, 6),
+                    "compute_s": round(compute_s, 6),
+                    "dispatches": int(dispatches),
+                    "bytes": size,
+                    "phases": phases,
+                }
+
+            # Encode: warm-up (compiles), then the measured pass.  The
+            # parity GEMM's output is the p parity rows.
+            api.encode_file(path, k, p, **enc)
+            rows.append(measure(
+                "encode",
+                lambda t: api.encode_file(path, k, p, timer=t, **enc),
+                rows_out=p,
+            ))
+            # Decode from the adversarial survivor set (first n-k chunks
+            # lost -> a real inversion + recovery GEMM, unit-test.sh's
+            # scenario), warm-up then measured.  The recovery GEMM
+            # computes ONLY the missing native rows: dropping the first
+            # n-k chunks erases min(p, k) natives.
+            conf = make_conf(k + p, k, path)
+            out = path + ".dec"
+            api.decode_file(path, conf, out, **common)
+            rows.append(measure(
+                "decode",
+                lambda t: api.decode_file(path, conf, out, timer=t,
+                                          **common),
+                rows_out=min(p, k),
+            ))
+    return rows
+
+
+# -- report ------------------------------------------------------------------
+
+
+def _plan_cost_for(plans: list[dict], strategy: str, w: int,
+                   rows_out: int) -> dict | None:
+    """Per-dispatch cost of the most-called cached plan matching
+    (strategy, w) — preferring an exact output-row match (encode plans
+    carry a (p, k) coefficient matrix, decode a (missing, k) recovery
+    matrix; with the adversarial survivor set the two coincide, which is
+    fine — the dispatch compute is then genuinely identical)."""
+    exact = None
+    any_match = None
+    for pl in plans:
+        if pl.get("strategy") != strategy or pl.get("w") != w \
+                or not pl.get("cost_analysis"):
+            continue
+        a_shape = pl.get("a_shape") or []
+        if len(a_shape) == 2 and a_shape[0] == rows_out and (
+            exact is None or pl.get("calls", 0) > exact.get("calls", 0)
+        ):
+            exact = pl
+        if any_match is None or pl.get("calls", 0) > any_match.get(
+            "calls", 0
+        ):
+            any_match = pl
+    best = exact or any_match
+    if best is None:
+        return None
+    return dict(best["cost_analysis"], bucket=best.get("bucket"),
+                calls=best.get("calls"))
+
+
+def classify_bound(bw_util: float, flop_util: float,
+                   threshold: float = BOUND_THRESHOLD) -> str:
+    """memory / compute / dispatch verdict from roof utilizations."""
+    if max(bw_util, flop_util) < threshold:
+        return "dispatch"
+    return "memory" if bw_util >= flop_util else "compute"
+
+
+def build_report(
+    rows: list[dict],
+    roofline: dict,
+    *,
+    k: int,
+    p: int,
+    w: int,
+    plan_stats: dict | None = None,
+    snapshot: dict | None = None,
+) -> dict:
+    """Fold measured rows + per-dispatch cost + the host roofline into
+    the attribution report (the ``rs analyze --json`` payload)."""
+    from .. import plan as _plan
+
+    if plan_stats is None:
+        plan_stats = _plan.PLAN_CACHE.stats()
+    plans = plan_stats.get("plans") or []
+    sym = w // 8
+    peak_bw = float(roofline.get("triad_gbps") or 0) or None
+    peak_fl = float(roofline.get("gemm_gflops") or 0) or None
+
+    out_rows = []
+    for r in rows:
+        op, strategy = r["op"], r["codec_strategy"]
+        # The dispatch's true output-row count, recorded by the workload
+        # (encode: p parity rows; decode: only the MISSING natives are
+        # recovered — NOT k).  Legacy rows without it fall back to the
+        # op-shaped default.
+        rows_out = r.get("rows_out") or (p if op == "encode" else min(p, k))
+        dispatches = max(1, r.get("dispatches") or 0)
+        # Per-dispatch column count in symbols: the payload divided over
+        # the measured dispatches.
+        chunk_syms = max(1, r["bytes"] // max(1, k) // sym)
+        cols = max(1, chunk_syms // dispatches)
+        cost = _plan_cost_for(plans, strategy, w, rows_out)
+        if cost is not None and cost.get("flops") is not None \
+                and cost.get("bytes_accessed"):
+            cost_source = "xla_cost_analysis"
+            flops_d = cost["flops"]
+            bytes_d = cost["bytes_accessed"]
+        else:
+            # Host codec, or a backend whose cost analysis came back
+            # None/partial: idealized analytic model.
+            cost_source = "analytic"
+            ac = analytic_cost(rows_out, k, cols, sym)
+            if cost is not None and cost.get("flops") is not None:
+                flops_d = cost["flops"]
+            else:
+                flops_d = ac["flops"]
+            bytes_d = ac["bytes_accessed"]
+        # Attribute against the *device-facing* wall: dispatch enqueue +
+        # the D2H block that hides device compute (host view).  Falls
+        # back to total wall when the phase split is empty (host codec
+        # runs inline: its dispatch phase IS the compute).
+        active_s = (r["dispatch_s"] + r["compute_s"]) or r["wall_s"]
+        flops_total = flops_d * dispatches
+        bytes_total = bytes_d * dispatches
+        gflops = flops_total / active_s / 1e9 if active_s > 0 else 0.0
+        gbps = bytes_total / active_s / 1e9 if active_s > 0 else 0.0
+        ai = flops_d / bytes_d if bytes_d else 0.0
+        bw_util = gbps / peak_bw if peak_bw else 0.0
+        flop_util = gflops / peak_fl if peak_fl else 0.0
+        out_rows.append({
+            "strategy": r["strategy"],
+            "codec_strategy": strategy,
+            "op": op,
+            "k": k,
+            "n": k + p,
+            "w": w,
+            "bytes": r["bytes"],
+            "wall_s": r["wall_s"],
+            "active_s": round(active_s, 6),
+            "dispatches": dispatches,
+            "end_to_end_gbps": round(
+                r["bytes"] / r["wall_s"] / 1e9, 6
+            ) if r["wall_s"] > 0 else None,
+            "achieved_gbps": round(gbps, 6),
+            "achieved_gflops": round(gflops, 6),
+            "arithmetic_intensity": round(ai, 6),
+            "cost_source": cost_source,
+            "flops_per_dispatch": flops_d,
+            "bytes_per_dispatch": bytes_d,
+            "pct_of_peak_bw": round(100 * bw_util, 3),
+            "pct_of_peak_flops": round(100 * flop_util, 3),
+            "bound": classify_bound(bw_util, flop_util),
+        })
+
+    if snapshot is None:
+        snapshot = _metrics.REGISTRY.snapshot()
+    latency = {}
+    for metric in ("rs_dispatch_wall_seconds", "rs_file_op_wall_seconds"):
+        fam = snapshot.get(metric)
+        if fam:
+            latency[metric] = {
+                label: {
+                    "count": v.get("count"),
+                    "max": v.get("max"),
+                    **(v.get("quantiles") or {}),
+                }
+                for label, v in fam.get("values", {}).items()
+                if isinstance(v, dict)
+            }
+    return {
+        "kind": "rs_analyze",
+        "schema": SCHEMA_VERSION,
+        "ts": time.time(),
+        "host": socket.gethostname(),
+        "backend": _runlog.backend_name(),
+        "config": {"k": k, "n": k + p, "w": w},
+        "roofline": roofline,
+        "strategies": out_rows,
+        "latency": latency,
+    }
+
+
+def render_report(report: dict) -> str:
+    """Human-readable `rs analyze` table."""
+    rl = report.get("roofline") or {}
+    cfg = report.get("config") or {}
+    lines = [
+        f"host {report.get('host')}  backend {report.get('backend')}  "
+        f"k={cfg.get('k')} n={cfg.get('n')} w={cfg.get('w')}",
+        f"roofline: {rl.get('triad_gbps')} GB/s triad, "
+        f"{rl.get('gemm_gflops')} GFLOP/s gemm "
+        f"({rl.get('source', '?')}, age {rl.get('age_s', '?')}s)",
+        "",
+        f"{'strategy':<10} {'op':<7} {'GB/s':>8} {'GFLOP/s':>9} "
+        f"{'AI':>7} {'%bw':>6} {'%flop':>6}  {'bound':<9} cost",
+    ]
+    for r in report.get("strategies", []):
+        lines.append(
+            f"{r['strategy']:<10} {r['op']:<7} "
+            f"{r['achieved_gbps']:>8.3f} {r['achieved_gflops']:>9.3f} "
+            f"{r['arithmetic_intensity']:>7.3f} "
+            f"{r['pct_of_peak_bw']:>6.1f} {r['pct_of_peak_flops']:>6.1f}  "
+            f"{r['bound']:<9} {r['cost_source']}"
+        )
+    lat = report.get("latency") or {}
+    for metric, series in sorted(lat.items()):
+        for label, q in sorted(series.items()):
+            p50, p99 = q.get("0.5"), q.get("0.99")
+            if p50 is None:
+                continue
+            lines.append(
+                f"{metric}{label}: p50 {p50 * 1e3:.3f} ms  "
+                f"p99 {(p99 or 0) * 1e3:.3f} ms  "
+                f"max {(q.get('max') or 0) * 1e3:.3f} ms  "
+                f"(n={q.get('count')})"
+            )
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """The ``rs analyze`` subcommand."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="rs analyze",
+        description="Roofline attribution: run a small per-strategy "
+        "encode/decode workload and report achieved GB/s, GFLOP/s, "
+        "arithmetic intensity and a memory/compute/dispatch bound "
+        "verdict against the calibrated host roofline.",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as one JSON document")
+    ap.add_argument("--workload", action="store_true",
+                    help="run the synthetic workload (the default; flag "
+                    "kept for symmetry with `rs stats --workload`)")
+    ap.add_argument("--strategies",
+                    default=",".join(DEFAULT_STRATEGIES),
+                    help="comma-separated strategy list (default "
+                    "table,bitplane,native; 'native' is the host codec)")
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--p", type=int, default=2)
+    ap.add_argument("--w", type=int, default=8, choices=(8, 16))
+    ap.add_argument("--size-kb", type=int, default=1024,
+                    help="workload payload size in KiB (default 1024)")
+    ap.add_argument("--segment-kb", type=int, default=256,
+                    help="segment size in KiB (default 256)")
+    ap.add_argument("--runlog", default=None,
+                    help="ledger for the roofline cache (default "
+                    "$RS_RUNLOG)")
+    ap.add_argument("--refresh-roofline", action="store_true",
+                    help="re-probe the host roofline even when a fresh "
+                    "ledger calibration exists")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    strategies = [s for s in args.strategies.split(",") if s]
+    bad = [s for s in strategies
+           if _STRATEGY_ALIASES.get(s, s) not in
+           ("table", "bitplane", "pallas", "cpu")]
+    if bad:
+        print(f"rs analyze: unknown strategies {bad}", file=sys.stderr)
+        return 2
+    if args.w != 8 and any(
+        _STRATEGY_ALIASES.get(s, s) == "cpu" for s in strategies
+    ):
+        print("rs analyze: the native host codec is w=8 only; drop it "
+              "from --strategies for --w 16", file=sys.stderr)
+        return 2
+    roofline = get_roofline(args.runlog, refresh=args.refresh_roofline)
+    rows = run_workload(
+        strategies, k=args.k, p=args.p, w=args.w,
+        size=args.size_kb * 1024, segment_bytes=args.segment_kb * 1024,
+    )
+    report = build_report(rows, roofline, k=args.k, p=args.p, w=args.w)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render_report(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,314 @@
+"""`rs doctor` — one-shot environment diagnostic.
+
+Every support thread for this system starts with the same questions:
+which backend is actually serving, is the native library built, which
+RS_* knobs are set, is the ledger writable, is anything scraping the
+metrics endpoint, and is the roofline calibration `rs analyze` depends
+on still fresh?  This module answers them in one run, human-readable or
+``--json`` (a schema-stable document — tests pin the section keys, so
+fleet tooling can depend on them).
+
+Sections:
+
+* ``python`` / ``jax`` — interpreter, jax version, default backend,
+  local device platforms/counts (degrading to the import error when no
+  backend initialises).
+* ``native`` — native C++ library presence, source digest, build error
+  if any.
+* ``mesh`` — local device count, ``jax.shard_map`` availability (the
+  carried mesh-failure set's signature), forced-host-device flags.
+* ``env`` — every ``RS_*`` knob currently set (the knobs are read per
+  call across the codebase, so this is the live configuration).
+* ``ledger`` — RS_RUNLOG presence, record count, writability.
+* ``metrics_endpoint`` — RS_METRICS_PORT reachability (one local HTTP
+  probe of ``/healthz``).
+* ``roofline`` — per-host calibration from the ledger and its age vs
+  ``RS_ROOFLINE_MAX_AGE_S`` (obs/attrib.py).
+
+Module import cost: stdlib only; jax loads lazily inside
+:func:`collect`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import platform
+import socket
+import sys
+import time
+
+from . import attrib as _attrib, runlog as _runlog
+
+SCHEMA_VERSION = 1
+
+# The --json document's stable surface (pinned by tests): these keys are
+# always present, whatever the environment looks like.
+SECTIONS = ("python", "jax", "native", "mesh", "env", "ledger",
+            "metrics_endpoint", "roofline")
+
+
+def _jax_section() -> dict:
+    out: dict = {"importable": False, "version": None, "backend": None,
+                 "devices": [], "device_count": 0, "error": None}
+    try:
+        import jax
+
+        out["importable"] = True
+        out["version"] = getattr(jax, "__version__", None)
+        out["backend"] = jax.default_backend()
+        devs = jax.local_devices()
+        out["device_count"] = len(devs)
+        out["devices"] = sorted({d.platform for d in devs})
+    except Exception as e:  # backend init can fail any number of ways
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _native_section() -> dict:
+    out: dict = {"available": False, "lib_path": None, "src_digest": None,
+                 "error": None}
+    try:
+        from .. import native
+
+        out["lib_path"] = getattr(native, "_SO", None)
+        src = getattr(native, "_SRC", None)
+        if src and os.path.exists(src):
+            with open(src, "rb") as fp:
+                out["src_digest"] = hashlib.sha256(
+                    fp.read()
+                ).hexdigest()[:12]
+        out["available"] = native.available()
+        if not out["available"]:
+            out["error"] = "native toolchain unavailable (NumPy fallback)"
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _mesh_section(jax_info: dict) -> dict:
+    out: dict = {
+        "local_device_count": jax_info.get("device_count", 0),
+        "shard_map_available": False,
+        "forced_host_devices": None,
+        "distributed_env": {},
+    }
+    xla_flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" in xla_flags:
+        out["forced_host_devices"] = xla_flags
+    for var in ("JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                "JAX_COORDINATOR_ADDRESS"):
+        if os.environ.get(var):
+            out["distributed_env"][var] = os.environ[var]
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        # The carried mesh-failure signature: old jax pins lack
+        # jax.shard_map (docs/STATUS.md, ROADMAP item 4).
+        out["shard_map_available"] = hasattr(jax, "shard_map")
+    return out
+
+
+def _ledger_section() -> tuple[dict, list[dict]]:
+    """Ledger facts plus the parsed records — read ONCE and shared with
+    the roofline section (a rotation-bound ledger is several MB; the
+    one-shot diagnostic must not JSON-parse it twice)."""
+    p = _runlog.path()
+    records: list[dict] = []
+    out: dict = {"path": p, "exists": False, "records": 0,
+                 "writable": None, "error": None}
+    if not p:
+        out["error"] = "RS_RUNLOG unset (no persistent run ledger)"
+        return out, records
+    out["exists"] = os.path.exists(p) or os.path.exists(p + ".1")
+    if out["exists"]:
+        try:
+            records = _runlog.read_records(p)
+            out["records"] = len(records)
+        except Exception as e:
+            out["error"] = f"{type(e).__name__}: {e}"
+    # Writability probe that MUTATES NOTHING: doctor diagnoses state, it
+    # must not create the ledger file a later existence check would read
+    # as "some operation wrote here".
+    if os.path.exists(p):
+        try:
+            fd = os.open(p, os.O_RDWR | os.O_APPEND)
+            os.close(fd)
+            out["writable"] = True
+        except OSError as e:
+            out["writable"] = False
+            out["error"] = f"{type(e).__name__}: {e}"
+    else:
+        parent = os.path.dirname(p) or "."
+        out["writable"] = os.access(parent, os.W_OK | os.X_OK)
+        if not out["writable"]:
+            out["error"] = f"parent directory {parent!r} not writable"
+    return out, records
+
+
+def _endpoint_section(probe: bool = True) -> dict:
+    port = os.environ.get("RS_METRICS_PORT")
+    out: dict = {"port": port, "reachable": None, "error": None}
+    if not port:
+        out["error"] = "RS_METRICS_PORT unset (no live /metrics endpoint)"
+        return out
+    if not probe:
+        return out
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{int(port)}/healthz", timeout=2
+        ) as resp:
+            out["reachable"] = resp.status == 200
+    except Exception as e:
+        out["reachable"] = False
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _roofline_section(ledger_records: list[dict]) -> dict:
+    out: dict = {"cached": False, "age_s": None, "fresh": None,
+                 "triad_gbps": None, "gemm_gflops": None,
+                 "max_age_s": _attrib.roofline_max_age_s()}
+    host = socket.gethostname()
+    rec = next(
+        (r for r in reversed(ledger_records)
+         if r.get("kind") == "rs_roofline" and r.get("host") == host),
+        None,
+    )
+    if rec is None:
+        return out
+    out["cached"] = True
+    out["triad_gbps"] = rec.get("triad_gbps")
+    out["gemm_gflops"] = rec.get("gemm_gflops")
+    age = time.time() - float(rec.get("ts") or 0)
+    out["age_s"] = round(age, 1)
+    out["fresh"] = 0 <= age < out["max_age_s"]
+    return out
+
+
+def collect(probe_endpoint: bool = True) -> dict:
+    """The full diagnostic document (the ``--json`` payload)."""
+    jax_info = _jax_section()
+    ledger, ledger_records = _ledger_section()
+    report = {
+        "kind": "rs_doctor",
+        "schema": SCHEMA_VERSION,
+        "ts": time.time(),
+        "host": socket.gethostname(),
+        "python": {
+            "version": platform.python_version(),
+            "executable": sys.executable,
+        },
+        "jax": jax_info,
+        "native": _native_section(),
+        "mesh": _mesh_section(jax_info),
+        "env": {
+            k: v for k, v in sorted(os.environ.items())
+            if k.startswith("RS_")
+        },
+        "ledger": ledger,
+        "metrics_endpoint": _endpoint_section(probe_endpoint),
+        "roofline": _roofline_section(ledger_records),
+    }
+    warnings = []
+    if not jax_info["importable"]:
+        warnings.append(f"jax failed to import: {jax_info['error']}")
+    if not report["native"]["available"]:
+        warnings.append("native library unavailable — host paths run on "
+                        "the NumPy fallback")
+    if not report["mesh"]["shard_map_available"]:
+        warnings.append("jax.shard_map missing — mesh paths will fail "
+                        "(the carried mesh-failure signature, "
+                        "docs/STATUS.md)")
+    if report["ledger"]["path"] and report["ledger"]["writable"] is False:
+        warnings.append(f"run ledger not writable: "
+                        f"{report['ledger']['error']}")
+    if report["roofline"]["cached"] and not report["roofline"]["fresh"]:
+        warnings.append("roofline calibration is stale — rs analyze will "
+                        "re-probe (or pass --refresh-roofline)")
+    report["warnings"] = warnings
+    return report
+
+
+def render(report: dict) -> str:
+    """Human-readable doctor output: one ok/!! line per fact."""
+
+    def mark(ok) -> str:
+        return "ok" if ok else "!!"
+
+    j = report["jax"]
+    n = report["native"]
+    m = report["mesh"]
+    led = report["ledger"]
+    ep = report["metrics_endpoint"]
+    rl = report["roofline"]
+    lines = [
+        f"rs doctor @ {report['host']} "
+        f"(python {report['python']['version']})",
+        f"[{mark(j['importable'])}] jax {j['version'] or '-'}: backend "
+        f"{j['backend'] or '-'}, {j['device_count']} device(s) "
+        f"{j['devices'] or ''}"
+        + (f" — {j['error']}" if j["error"] else ""),
+        f"[{mark(n['available'])}] native lib: "
+        + (f"{n['lib_path']} (src {n['src_digest']})"
+           if n["available"] else str(n["error"])),
+        f"[{mark(m['shard_map_available'])}] mesh: "
+        f"{m['local_device_count']} local device(s), shard_map "
+        f"{'present' if m['shard_map_available'] else 'MISSING'}"
+        + (f", {m['distributed_env']}" if m["distributed_env"] else ""),
+        "[--] RS_* knobs: "
+        + (", ".join(f"{k}={v}" for k, v in report["env"].items())
+           or "(none set)"),
+        f"[{mark(led['writable'])}] ledger: "
+        + (f"{led['path']} ({led['records']} records)"
+           if led["path"] else "RS_RUNLOG unset"),
+        # reachable is None when the probe was skipped (--no-probe): an
+        # untested endpoint must not render as an outage.
+        f"[{'--' if ep['reachable'] is None and ep['port'] else mark(ep['reachable'])}] "
+        "metrics endpoint: "
+        + (f"port {ep['port']} "
+           + ("not probed" if ep["reachable"] is None
+              else "reachable" if ep["reachable"] else "UNREACHABLE")
+           if ep["port"] else "RS_METRICS_PORT unset"),
+        f"[{mark(rl['cached'] and rl['fresh'])}] roofline: "
+        + (f"{rl['triad_gbps']} GB/s triad / {rl['gemm_gflops']} GFLOP/s "
+           f"gemm, age {rl['age_s']}s "
+           f"({'fresh' if rl['fresh'] else 'STALE'})"
+           if rl["cached"] else "not calibrated (run rs analyze)"),
+    ]
+    for w in report.get("warnings", []):
+        lines.append(f"  warning: {w}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    """The ``rs doctor`` subcommand."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="rs doctor",
+        description="One-shot environment diagnostic: backends/devices, "
+        "native lib, mesh sanity, RS_* knobs, ledger and metrics-endpoint "
+        "reachability, roofline calibration freshness.",
+    )
+    ap.add_argument("--json", action="store_true",
+                    help="emit the schema-stable JSON document")
+    ap.add_argument("--no-probe", action="store_true",
+                    help="skip the live /healthz endpoint probe")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    report = collect(probe_endpoint=not args.no_probe)
+    if args.json:
+        print(json.dumps(report))
+    else:
+        print(render(report))
+    # Exit 0 even with warnings: doctor diagnoses, CI gates elsewhere.
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

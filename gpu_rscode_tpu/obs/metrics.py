@@ -33,6 +33,9 @@ import bisect
 import os
 import threading
 
+from . import percentile as _percentile
+from .percentile import DEFAULT_RESERVOIR, QuantileEstimator
+
 _TRUTHY = ("1", "true", "on", "yes")
 
 # force_enable() latch: the CLI's --metrics-json/stats surfaces must be able
@@ -52,6 +55,13 @@ def force_enable(on: bool = True) -> None:
     ``--metrics-json`` and by tests."""
     global _FORCED
     _FORCED = on
+
+
+def forced() -> bool:
+    """Current latch state — lets a temporary enabler (the `rs analyze`
+    workload) save and restore it instead of flipping the process-global
+    gate permanently."""
+    return _FORCED
 
 
 class _Null:
@@ -251,6 +261,43 @@ class Histogram(_Metric):
         self._default().observe(v)
 
 
+class _QuantileChild(_ChildBase):
+    __slots__ = ("est",)
+
+    def __init__(self, lock, cap):
+        super().__init__(lock)
+        self.est = QuantileEstimator(cap)
+
+    def observe(self, v):
+        with self._lock:
+            self.est.observe(v)
+
+    def state(self) -> dict:
+        with self._lock:
+            return self.est.state()
+
+
+class Quantile(_Metric):
+    """Streaming-percentile metric (tail latency): a mergeable
+    fixed-size reservoir per labeled series (obs/percentile.py), reported
+    as p50/p90/p99 + exact min/max/sum/count.  The percentile sibling of
+    :class:`Histogram` — same ``observe()`` surface, no bucket edges to
+    choose, and the snapshot carries the estimator state so
+    ``rs aggregate`` can merge parts across hosts."""
+
+    kind = "quantile"
+
+    def __init__(self, name, help="", cap=DEFAULT_RESERVOIR):
+        super().__init__(name, help)
+        self.cap = int(cap)
+
+    def _new_child(self):
+        return _QuantileChild(self._lock, self.cap)
+
+    def observe(self, v):
+        self._default().observe(v)
+
+
 class Registry:
     """Thread-safe name -> metric table.
 
@@ -295,6 +342,19 @@ class Registry:
             )
         return h
 
+    def quantile(
+        self, name: str, help: str = "", cap=DEFAULT_RESERVOIR
+    ) -> Quantile:
+        q = self._get_or_create(Quantile, name, help, cap=cap)
+        if q.cap != int(cap):
+            # Same contract as the histogram bucket check: one series,
+            # one reservoir size.
+            raise ValueError(
+                f"quantile {name!r} already registered with cap {q.cap}, "
+                f"requested {cap}"
+            )
+        return q
+
     def names(self) -> list[str]:
         with self._lock:
             return sorted(self._metrics)
@@ -322,6 +382,14 @@ class Registry:
                         "sum": child.sum,
                         "buckets": child.cumulative(),
                     }
+                elif isinstance(child, _QuantileChild):
+                    state = child.state()
+                    # The computed percentile family rides along so every
+                    # consumer (rs stats, rs analyze, /metrics) reads the
+                    # same numbers; the raw reservoir state is what
+                    # rs aggregate merges.
+                    state["quantiles"] = _percentile.state_quantiles(state)
+                    values[_label_str(key)] = state
                 else:
                     values[_label_str(key)] = child.value
             out[m.name] = {"type": m.kind, "help": m.help, "values": values}
@@ -359,6 +427,10 @@ def gauge(name: str, help: str = ""):
 
 def histogram(name: str, help: str = "", buckets=DEFAULT_BUCKETS):
     return REGISTRY.histogram(name, help, buckets) if enabled() else NULL
+
+
+def quantile(name: str, help: str = "", cap=DEFAULT_RESERVOIR):
+    return REGISTRY.quantile(name, help, cap) if enabled() else NULL
 
 
 def unified_snapshot() -> dict:

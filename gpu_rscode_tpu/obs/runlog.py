@@ -375,14 +375,18 @@ def filter_records(
     header once, not every row — so ``rs history --op io_bench`` trends a
     raw capture file); config filters compare against the record's
     ``config`` dict and skip records that lack the field only when the
-    filter asks for it.  Capture headers themselves are dropped — they
-    are identity, not measurements.
+    filter asks for it.  Capture headers and roofline-calibration
+    records (``rs_roofline``, obs/attrib.py) are dropped — they are
+    identity/calibration state, not measurements, and must not occupy
+    trend-window slots or print as junk rows.
     """
     out = []
     header_tool = None
     for r in records:
         if r.get("kind") == "capture_header":
             header_tool = r.get("tool")
+            continue
+        if r.get("kind") == "rs_roofline":
             continue
         cfg = r.get("config") or {}
         if op is not None and op not in (

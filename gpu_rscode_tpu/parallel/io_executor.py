@@ -183,10 +183,15 @@ class DrainExecutor:
 
         with _tracing.span("write_drain", lane=lane, nbytes=nbytes):
             _retry.default_policy().call(attempt, op="write_drain")
+        dt = time.perf_counter() - t0
         _metrics.counter(
             "rs_io_write_seconds_total",
             "wall seconds spent in drain (D2H wait + write) tasks",
-        ).labels(lane=lane).inc(time.perf_counter() - t0)
+        ).labels(lane=lane).inc(dt)
+        _metrics.quantile(
+            "rs_io_drain_wall_seconds",
+            "writer-lane drain task wall seconds (streaming quantiles)",
+        ).labels(lane=lane).observe(dt)
 
     def _report_depth(self) -> None:
         if self._q is not None:

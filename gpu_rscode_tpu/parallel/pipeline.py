@@ -23,6 +23,11 @@ from ..obs import metrics as _metrics, tracing as _tracing
 
 T = TypeVar("T")
 
+# Process-lifetime staging-ring occupancy watermark (see
+# DeviceStagingRing._report_occupancy).  Held in a list so tests can
+# reset it without rebinding the module attribute they imported.
+_RING_PEAK = [0]
+
 
 class AsyncWindow(Generic[T]):
     """Bounded window of in-flight async results.
@@ -148,6 +153,18 @@ class DeviceStagingRing:
             "rs_staging_ring_occupancy",
             "segments staged on-device ahead of the consumer",
         ).set(n)
+        # High-watermark across EVERY ring of the process (one ring per
+        # file op — a per-ring peak would let a later small op overwrite
+        # the fleet answer "did any ring ever fill" downward).  The
+        # module global is gated on enabled() so a climb during a
+        # disabled run cannot suppress the gauge of a later enabled one.
+        if _metrics.enabled() and n > _RING_PEAK[0]:
+            _RING_PEAK[0] = n
+            _metrics.gauge(
+                "rs_staging_ring_occupancy_peak",
+                "process-wide high watermark of staged segments ahead "
+                "of the consumer",
+            ).set(n)
         _tracing.counter("staging_ring_occupancy", staged=n)
 
     def _fill(self) -> None:

@@ -45,16 +45,51 @@ from ..ops.gf import get_field
 from .mesh import COLS, STRIPE
 
 
-@functools.partial(
-    jax.jit, static_argnames=("mesh", "w", "strategy", "stripe_sharded")
-)
-def sharded_gf_matmul(A, B, *, mesh, w=8, strategy="bitplane", stripe_sharded=False):
+def sharded_gf_matmul(A, B, *, mesh, w=8, strategy="bitplane",
+                      stripe_sharded=False):
     """``C = A . B`` over GF(2^w), B sharded over the mesh.
 
     ``A``: (p, k) coefficient matrix (replicated; sharded along k when
     ``stripe_sharded``).  ``B``: (k, m) global data.  Returns (p, m) sharded
     along ``cols`` (replicated along ``stripe``).
+
+    This wrapper is the mesh path's accounting boundary (the compute
+    lives in the jitted ``_sharded_gf_matmul_jit``): each eager dispatch
+    records a ``mesh_dispatch`` span and counts the collective payload in
+    ``rs_mesh_collective_bytes_total{op}`` — stripe mode's psum moves
+    ``p * w * m`` int8 pre-parity plane bytes per segment (the logical
+    reduce volume; the ring transfer is ~2x that on real links), cols
+    mode moves nothing — so ``rs analyze`` can attribute mesh-path cost
+    next to the staged-byte counters.  Skipped under an outer trace
+    (tracers have no concrete byte counts to account).
     """
+    if not isinstance(B, jax.core.Tracer):
+        m = int(B.shape[1])
+        if stripe_sharded:
+            p_rows = int(A.shape[0])
+            _metrics.counter(
+                "rs_mesh_collective_bytes_total",
+                "logical bytes through mesh collectives per dispatch",
+            ).labels(op="psum_stripe").inc(p_rows * w * m)
+        with _tracing.span(
+            "mesh_dispatch", lane="dispatch", strategy=str(strategy),
+            stripe=bool(stripe_sharded), cols=m,
+        ):
+            return _sharded_gf_matmul_jit(
+                A, B, mesh=mesh, w=w, strategy=strategy,
+                stripe_sharded=stripe_sharded,
+            )
+    return _sharded_gf_matmul_jit(
+        A, B, mesh=mesh, w=w, strategy=strategy,
+        stripe_sharded=stripe_sharded,
+    )
+
+
+@functools.partial(
+    jax.jit, static_argnames=("mesh", "w", "strategy", "stripe_sharded")
+)
+def _sharded_gf_matmul_jit(A, B, *, mesh, w=8, strategy="bitplane",
+                           stripe_sharded=False):
     gf = get_field(w)
     out_dtype = jnp.uint8 if gf.dtype == np.uint8 else jnp.uint16
 

@@ -61,7 +61,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from .obs import metrics as _metrics, tracing as _tracing
+from .obs import attrib as _attrib, metrics as _metrics, tracing as _tracing
 
 
 def enabled() -> bool:
@@ -185,6 +185,13 @@ def stage_segment(B, cap: int | None, retain_host: bool = True):
         "rs_segments_staged_total",
         "segments bucket-padded and staged onto the device (H2D issued)",
     ).inc()
+    if host is not None:
+        # Donation watermark (obs/attrib.py): the extra host memory the
+        # donation-recovery copies pin while their segment is in flight.
+        _metrics.counter(
+            "rs_donation_host_copy_bytes_total",
+            "bytes of retained host copies backing donatable segments",
+        ).inc(int(host.nbytes))
     return StagedSegment(staged, B.shape[1], cap, host=host)
 
 
@@ -194,7 +201,7 @@ class ExecutionPlan:
 
     __slots__ = (
         "key", "strategy", "w", "bucket", "refold", "calls", "donated_calls",
-        "compile_seconds", "_compiled", "_lock",
+        "compile_seconds", "cost_analysis", "_compiled", "_lock",
     )
 
     def __init__(self, key, strategy, w, bucket):
@@ -206,6 +213,7 @@ class ExecutionPlan:
         self.calls = 0
         self.donated_calls = 0
         self.compile_seconds = 0.0  # lower+compile wall across all variants
+        self.cost_analysis = None   # XLA cost model of one dispatch, or None
         self._compiled: dict = {}   # donate(bool) -> jax Compiled
         self._lock = threading.Lock()   # serializes this plan's builds
 
@@ -226,6 +234,14 @@ class ExecutionPlan:
             ).compile()
         dt = time.perf_counter() - t0
         self.compile_seconds += dt  # under the plan's own lock (see run())
+        if self.cost_analysis is None:
+            # Roofline accounting (obs/attrib.py): the XLA cost model of
+            # one dispatch — FLOPs, bytes accessed, transcendentals.
+            # Variants share compute (donate only changes aliasing), so
+            # the first variant's analysis stands for the plan; backends
+            # that return None/partial leave it None and `rs analyze`
+            # falls back to the analytic model.
+            self.cost_analysis = _attrib.extract_cost_analysis(exe)
         _metrics.histogram(
             "rs_plan_compile_seconds",
             "wall seconds spent in AOT lower+compile per plan variant",
@@ -314,6 +330,7 @@ class ExecutionPlan:
             "calls": self.calls,
             "donated_calls": self.donated_calls,
             "compile_seconds": self.compile_seconds,
+            "cost_analysis": self.cost_analysis,
         }
 
 

@@ -93,6 +93,66 @@ def test_histogram_all_inf_preserved():
     assert b["+Inf"] == 3 and b["1.0"] == 1
 
 
+@pytest.mark.parametrize("seed,nparts", [(3, 2), (77, 4), (20260804, 7)])
+def test_quantile_merge_approximates_single_stream(seed, nparts):
+    """ISSUE 6 contract: an N-part quantile merge must agree with the
+    single-stream estimator within the estimator's own error bounds —
+    exact count/sum/min/max, percentiles within a few reservoir standard
+    errors (cap 512 -> rank SE ~ 1/sqrt(512) ~ 4.4%% of the range for a
+    uniform stream; 5x that is far below what any systematic merge bias
+    would produce)."""
+    from gpu_rscode_tpu.obs.percentile import QuantileEstimator
+
+    rng = random.Random(seed)
+    parts = [metrics.Registry() for _ in range(nparts)]
+    ref = QuantileEstimator()
+    total, checksum = 0, 0.0
+    for _ in range(rng.randint(2000, 6000)):
+        v = rng.random() * 10.0
+        parts[rng.randrange(nparts)].quantile("lat").observe(v)
+        ref.observe(v)
+        total += 1
+        checksum += v
+    merged = aggregate.merge_snapshots([r.snapshot() for r in parts])
+    got = merged["lat"]["values"][""]
+    assert got["count"] == total == ref.count
+    assert got["sum"] == pytest.approx(checksum)
+    assert got["min"] == ref.min and got["max"] == ref.max
+    for q in (0.5, 0.9, 0.99):
+        assert got["quantiles"][repr(q)] == pytest.approx(
+            ref.quantile(q), abs=10.0 * 5 * 0.044
+        ), f"p{q} drifted past estimator error bounds"
+
+
+def test_quantile_merge_exact_below_cap():
+    """While the union of streams fits one reservoir, the merge is
+    EXACT — every value survives, so percentiles equal the true ones."""
+    r1, r2 = metrics.Registry(), metrics.Registry()
+    vals = [float(v) for v in range(100)]
+    for v in vals[:50]:
+        r1.quantile("lat").observe(v)
+    for v in vals[50:]:
+        r2.quantile("lat").observe(v)
+    merged = aggregate.merge_snapshots([r1.snapshot(), r2.snapshot()])
+    got = merged["lat"]["values"][""]
+    assert sorted(got["reservoir"]) == vals
+    assert got["quantiles"]["0.5"] == pytest.approx(49.5)
+    assert got["max"] == 99.0 and got["min"] == 0.0
+
+
+def test_quantile_renders_as_prometheus_summary():
+    r = metrics.Registry()
+    for v in (0.1, 0.2, 0.3):
+        r.quantile("lat_q", "latency").labels(op="encode").observe(v)
+    text = aggregate.render_text(
+        aggregate.merge_snapshots([r.snapshot()])
+    )
+    assert "# TYPE lat_q summary" in text
+    assert 'lat_q{op="encode",quantile="0.5"} 0.2' in text
+    assert 'lat_q_count{op="encode"} 3' in text
+    assert 'lat_q_max{op="encode"} 0.3' in text
+
+
 def test_merge_type_conflict_raises():
     r1, r2 = metrics.Registry(), metrics.Registry()
     r1.counter("x").inc()

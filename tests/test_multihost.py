@@ -25,6 +25,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+@pytest.mark.mesh_known_failure
 def test_two_process_sharded_gemm(tmp_path):
     port = _free_port()
     procs = []
