@@ -12,6 +12,10 @@ Public surface:
 - :mod:`gpu_rscode_tpu.cli` — the ``rs`` command (``python -m gpu_rscode_tpu``).
 - :mod:`gpu_rscode_tpu.ops` — GF(2^w) tables, GF-GEMM (XLA + Pallas), inversion.
 - :mod:`gpu_rscode_tpu.parallel` — mesh sharding + streaming pipelines.
+- :mod:`gpu_rscode_tpu.gf_decode` — error-locating generalized-RS decode:
+  parity-check syndromes (plan-cached GF-GEMM) + Berlekamp–Welch solver,
+  recovering silent bitrot without CRCs (docs/RESILIENCE.md, ``rs decode
+  --locate`` / :func:`gpu_rscode_tpu.api.locate_decode_file`).
 - :mod:`gpu_rscode_tpu.plan` — shape-bucketed execution plans: the bounded
   AOT-executable cache (``plan.PLAN_CACHE``), buffer donation, and the
   bucket ladder that keeps tail segments from recompiling (docs/PLAN.md).

@@ -2085,12 +2085,61 @@ def auto_decode_file(
     attempts = max(1, _retry.int_env("RS_RETRY_RESELECT", 3) + 1)
     excluded: dict[int, str] = {}
     last: Exception | None = None
+    locate_mode = _locate_mode()
+
+    def _locate_kwargs() -> dict:
+        out = {
+            key: decode_kwargs[key]
+            for key in ("strategy", "segment_bytes", "pipeline_depth",
+                        "timer")
+            if key in decode_kwargs
+        }
+        out["conf_out"] = conf_path
+        return out
+
     for attempt in range(attempts):
         scan = _scan_chunks(
             in_file, decode_kwargs.get("segment_bytes", DEFAULT_SEGMENT_BYTES)
         )
         if excluded:
             scan = scan.excluding(excluded)
+        # Escalation rung 0 — locate-first when CRC verification cannot
+        # protect this decode: the archive carries NO checksum lines, so
+        # silent bitrot would pass straight into the output.  (When CRC
+        # lines exist, the _scan_chunks above already read and verified
+        # every chunk — even under the caller's verify_checksums=False,
+        # which only skips decode_file's SECOND pass — so rot cannot
+        # reach the erasure decode and locate would be pure overhead.)
+        # RS_LOCATE=force engages it unconditionally, RS_LOCATE=off
+        # never.  Prerequisites (systematic matrix, erasures <= p,
+        # non-empty archive) fall back to the erasure ladder below; a
+        # transient locate failure falls back too — the erasure ladder
+        # owns the retry/degraded machinery.
+        crc_off = not scan.crcs
+        if (
+            attempt == 0
+            and scan.total_size > 0
+            and (locate_mode == "force"
+                 or (locate_mode == "auto" and crc_off))
+            and _locate_context(scan) is not None
+        ):
+            from .gf_decode import UnlocatableError
+
+            try:
+                return locate_decode_file(
+                    in_file, output, _scan=scan, **_locate_kwargs()
+                )
+            except UnlocatableError:
+                raise  # never fall back to a silently-wrong erasure decode
+            except (ValueError, OSError) as e:
+                # Anything else locate trips over (transient I/O, subset
+                # search cap, foreign-metadata corners) belongs to the
+                # erasure ladder below — it owns the retry/reselect
+                # machinery and raises the canonical errors.
+                _obs_tracing.instant(
+                    "locate_fallback", lane="retry",
+                    error=type(e).__name__,
+                )
         chosen, _ = _select_subset_retrying(scan)
         write_conf(
             conf_path,
@@ -2113,6 +2162,33 @@ def auto_decode_file(
             if isinstance(e, ChunkIntegrityError):
                 excluded.update(e.bad_chunks)
             if attempt + 1 >= attempts:
+                # Escalation's final rung: the reselect loop is
+                # exhausted — survivors keep failing under the erasure
+                # model.  One error-locating attempt (fresh scan, all
+                # present chunks, syndrome-verified corrections) before
+                # giving up; its own failure re-raises the LADDER's
+                # error, the actionable one.
+                if locate_mode != "off":
+                    rescan = _scan_chunks(
+                        in_file,
+                        decode_kwargs.get(
+                            "segment_bytes", DEFAULT_SEGMENT_BYTES
+                        ),
+                    )
+                    if _locate_context(rescan) is not None:
+                        try:
+                            out = locate_decode_file(
+                                in_file, output, _scan=rescan,
+                                **_locate_kwargs()
+                            )
+                        except (ValueError, OSError):
+                            raise e
+                        _obs_metrics.counter(
+                            "rs_degraded_decodes_total",
+                            "decodes completed after survivor "
+                            "reselection",
+                        ).labels(stage="locate").inc()
+                        return out
                 raise
             _obs_tracing.instant(
                 "degraded_reselect", lane="retry", attempt=attempt + 1,
@@ -2126,6 +2202,342 @@ def auto_decode_file(
             ).labels(stage="reselect").inc()
         return out
     raise last  # unreachable: the last attempt re-raises above
+
+
+# -- error-locating decode (gf_decode/, docs/RESILIENCE.md) -------------------
+#
+# The escalation ladder's final rung: silent bitrot — corruption in a
+# chunk that passes no CRC — is invisible to the erasure path, which
+# would propagate it into the output.  The locate path reads ALL present
+# chunks, computes parity-check syndromes per segment (a plan-cached
+# GF-GEMM, codec.syndrome), solves the key equation for error locations
+# + magnitudes (gf_decode/bw.py), patches the located symbols in place,
+# and only then runs the normal inverse-GEMM reconstruction.  Columns
+# whose damage exceeds t = floor((p - erasures)/2) raise
+# UnlocatableError — never a silently wrong output.
+
+
+def _locate_mode() -> str:
+    """RS_LOCATE knob: ``auto`` (default — engage when CRC verification
+    is unavailable/off), ``off`` (never), ``force`` (locate-first even
+    with CRCs)."""
+    v = os.environ.get("RS_LOCATE", "auto").strip().lower()
+    if v in ("0", "off", "no", "false"):
+        return "off"
+    if v in ("1", "force", "always"):
+        return "force"
+    return "auto"
+
+
+def _locate_context(scan: "_ChunkScan"):
+    """A gf_decode.LocateContext for this scan, or None when the locate
+    prerequisites don't hold (non-systematic foreign matrix, more
+    erasures than parity, zero-size archive) — callers fall back to the
+    erasure-only ladder."""
+    from .gf_decode import LocateContext
+
+    if scan.chunk == 0 or len(scan.healthy) < scan.k:
+        return None
+    try:
+        return LocateContext(
+            scan.total_mat, scan.k, scan.p, scan.w, scan.healthy
+        )
+    except ValueError:
+        return None
+
+
+def _count_syndrome_verdict(verdict: str) -> None:
+    _obs_metrics.counter(
+        "rs_syndrome_checks_total",
+        "per-segment syndrome-check verdicts (error-locating decode)",
+    ).labels(verdict=verdict).inc()
+
+
+def _count_located(n: int, w: int) -> None:
+    if n:
+        _obs_metrics.counter(
+            "rs_located_errors_total",
+            "symbol errors located and corrected by syndrome decode",
+        ).labels(w=w).inc(n)
+
+
+def _locate_segment_fixes(ctx, codec, seg, seg_cols, sym, off, cols, timer):
+    """One segment's syndrome check: dispatch S = check @ seg through the
+    plan cache, locate on host, return the verified corrections dict
+    (column -> [(chunk, magnitude)]).  Raises gf_decode.UnlocatableError
+    past the t bound (counted before it propagates)."""
+    from .gf_decode import UnlocatableError
+
+    if ctx.r == 0:
+        _count_syndrome_verdict("no_headroom")
+        return {}
+    with timer.phase("syndrome dispatch"), _dispatch_span(
+        "syndrome", off, cols
+    ):
+        staged = codec.stage_segment(
+            seg, cap=seg_cols // sym, sym=sym, out_rows=ctx.r
+        )
+        S = codec.syndrome(ctx.check, staged)  # async
+    with timer.phase("syndrome locate"):
+        S_np = np.asarray(S).astype(np.int64)
+        try:
+            fixes = ctx.locate(S_np)
+        except UnlocatableError:
+            _count_syndrome_verdict("unlocatable")
+            raise
+    _count_syndrome_verdict("silent_bitrot" if fixes else "clean")
+    _count_located(sum(len(v) for v in fixes.values()), ctx.w)
+    return fixes
+
+
+def _syndrome_sweep(
+    in_file: str,
+    scan: "_ChunkScan",
+    *,
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    timer: PhaseTimer | None = None,
+) -> tuple[str, set[int], int]:
+    """Read-only syndrome pre-check over an archive's present chunks (the
+    scrub half of the locate path — ``rs scrub --syndrome``).
+
+    Returns ``(verdict, located_chunks, symbol_errors, complete)``;
+    verdict is one of ``clean`` / ``silent_bitrot`` (with the rotten
+    chunk indices attributed — no CRCs involved) / ``unlocatable``
+    (damage beyond the t bound somewhere; the sweep stops at the first
+    such segment, so ``complete`` is False and ``located_chunks`` covers
+    only the segments checked up to that point — a PARTIAL attribution,
+    each entry individually verified) / ``no_headroom`` (erasures
+    consumed the check, or the metadata is foreign/non-systematic —
+    nothing checkable)."""
+    from . import native
+    from .gf_decode import UnlocatableError
+
+    timer = timer or PhaseTimer(enabled=False)
+    ctx = _locate_context(scan)
+    if ctx is None or ctx.r == 0:
+        _count_syndrome_verdict("no_headroom")
+        return "no_headroom", set(), 0, True
+    codec = RSCodec(scan.k, scan.p, w=scan.w, strategy=strategy)
+    sym = scan.w // 8
+    seg_cols = _segment_cols(scan.chunk, scan.k, segment_bytes)
+    paths = [chunk_file_name(in_file, i) for i in ctx.survivors]
+    fps = [open(p_, "rb") for p_ in paths]
+    maps = [np.memmap(p_, dtype=np.uint8, mode="r") for p_ in paths]
+    located: set[int] = set()
+    errors = 0
+    try:
+        def stage(off: int, cols: int) -> np.ndarray:
+            def attempt() -> np.ndarray:
+                _faults.on_reads(paths, ctx.survivors, scope="scrub")
+                return native.gather_rows(fps, off, cols, fallback_maps=maps)
+
+            with timer.phase("stage segment (io)"):
+                return _retry.default_policy().call(
+                    attempt, op="syndrome_stage"
+                )
+
+        with SegmentPrefetcher(
+            _segment_spans(scan.chunk, seg_cols), stage, depth=2
+        ) as prefetch:
+            for (off, cols), seg in prefetch:
+                try:
+                    fixes = _locate_segment_fixes(
+                        ctx, codec, seg, seg_cols, sym, off, cols, timer
+                    )
+                except UnlocatableError:
+                    return "unlocatable", located, errors, False
+                for col_fixes in fixes.values():
+                    for chunk_idx, _mag in col_fixes:
+                        located.add(chunk_idx)
+                    errors += len(col_fixes)
+    finally:
+        for fp in fps:
+            fp.close()
+    return (
+        ("silent_bitrot" if located else "clean"), located, errors, True
+    )
+
+
+@_observed_file_op("locate_decode")
+def locate_decode_file(
+    in_file: str,
+    output: str | None = None,
+    *,
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    pipeline_depth: int = 2,
+    conf_out: str | None = None,
+    timer: PhaseTimer | None = None,
+    _scan: "_ChunkScan | None" = None,
+) -> str:
+    """Rebuild ``in_file`` with error-LOCATING decode (``rs decode
+    --locate``): no conf and no CRCs needed — silent bitrot in up to
+    ``t = floor((p - missing)/2)`` chunks per symbol column is found,
+    attributed and corrected from the code's own redundancy before the
+    normal inverse-GEMM reconstruction runs.
+
+    Reads ALL present full-size chunks; missing/truncated ones are
+    erasures (classical trade: 2·errors + erasures <= p per column).
+    Raises :class:`gf_decode.UnlocatableError` when any column's damage
+    exceeds the bound — the archive may be wrong in ways the code cannot
+    pin down, and fabricating bytes is worse than failing.  Semantics,
+    miscorrection bounds and knobs: docs/RESILIENCE.md "Error location".
+    """
+    from . import native
+    from .ops.gf import get_field
+
+    timer = timer or PhaseTimer(enabled=False)
+    t_start = time.perf_counter()
+    # ``_scan`` (private, supplied by auto_decode_file's escalation
+    # rungs): reuse the ladder's fresh scan instead of re-reading — and
+    # re-CRC-ing, on checksummed archives — the whole chunk set.
+    if _scan is not None:
+        scan = _scan
+    else:
+        with timer.phase("scan chunks (io)"):
+            scan = _scan_chunks(in_file, segment_bytes)
+    if scan.total_size == 0:
+        # Zero-size foreign archive: same contract as decode_file.
+        _select_decodable_subset(scan)
+        return _write_empty_atomic(output or in_file)
+    ctx = _locate_context(scan)
+    if ctx is None:
+        from .gf_decode import is_systematic
+
+        if not is_systematic(scan.total_mat, scan.k):
+            raise ValueError(
+                f"{in_file!r}: error-locating decode needs a systematic "
+                "total matrix; this archive's metadata is foreign — use "
+                "the erasure decoder (rs -d --auto)"
+            )
+        raise ValueError(
+            f"only {len(scan.healthy)} healthy chunks of the k={scan.k} "
+            f"needed (corrupt: {sorted(scan.bad)}, missing: "
+            f"{scan.missing}) — past erasure recovery, locate cannot help"
+        )
+    k, p, w = scan.k, scan.p, scan.w
+    sym = w // 8
+    chunk = scan.chunk
+    seg_cols = _segment_cols(chunk, k, segment_bytes)
+    codec = RSCodec(k, p, w=w, strategy=strategy)
+    gf = get_field(w)
+
+    # Recovery GEMM for natives lost to ERASURE (located errors are
+    # patched in place, so present natives pass straight through).  With
+    # no native missing — the dominant silent-bitrot case — there is
+    # nothing to invert: the k natives themselves are the (trivially
+    # decodable) survivor set, and the subset search would be dead work
+    # whose UndecidedSubsetError corner could fail an otherwise
+    # recoverable archive.
+    missing = [i for i in range(k) if i not in set(ctx.survivors)]
+    if missing:
+        with timer.phase("invert matrix"):
+            chosen, inv = _select_decodable_subset(scan)
+        dec_missing = np.asarray(inv).astype(gf.dtype)[missing]
+    else:
+        chosen, dec_missing = list(range(k)), None
+    row_of = {c: i for i, c in enumerate(ctx.survivors)}
+    chosen_rows = [row_of[c] for c in chosen]
+    rec_row = {i: j for j, i in enumerate(missing)}
+
+    if conf_out:
+        write_conf(
+            conf_out,
+            [os.path.basename(chunk_file_name(in_file, i)) for i in chosen],
+        )
+
+    out_path = output or in_file
+    tmp_path = out_path + ".rs_tmp"
+    paths = [chunk_file_name(in_file, i) for i in ctx.survivors]
+    fps = [open(p_, "rb") for p_ in paths]
+    maps = [np.memmap(p_, dtype=np.uint8, mode="r") for p_ in paths]
+    try:
+        out_fp = open(tmp_path, "wb")
+    except BaseException:
+        for fp in fps:
+            fp.close()
+        raise
+
+    def write_row(i: int, off: int, cols: int, row_bytes: np.ndarray):
+        lo = i * chunk + off
+        if lo >= scan.total_size:
+            return
+        hi = min(lo + cols, scan.total_size)
+        out_fp.seek(lo)
+        out_fp.write(np.ascontiguousarray(row_bytes[: hi - lo]).tobytes())
+        _obs_metrics.counter(
+            "rs_io_write_bytes_total",
+            "bytes write by the staging-I/O layer",
+        ).labels(call="stream_write").inc(hi - lo)
+
+    def stage(off: int, cols: int) -> np.ndarray:
+        def attempt() -> np.ndarray:
+            _faults.on_reads(paths, ctx.survivors)
+            return native.gather_rows(fps, off, cols, fallback_maps=maps)
+
+        with timer.phase("stage segment (io)"):
+            return _retry.default_policy().call(attempt, op="locate_stage")
+
+    try:
+        from .gf_decode import correct_segment
+
+        # Sequential segment loop (prefetch overlaps the reads): the
+        # host-side locate between the syndrome GEMM and the recovery
+        # GEMM is a true pipeline barrier — np.asarray(S) both fences
+        # the async staging H2D (so the later in-place patch cannot race
+        # it) and hands the solver concrete syndromes.  Robustness path,
+        # not the hot path; the write-behind lanes stay with decode_file.
+        with SegmentPrefetcher(
+            _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
+        ) as prefetch:
+            for (off, cols), seg in prefetch:
+                fixes = _locate_segment_fixes(
+                    ctx, codec, seg, seg_cols, sym, off, cols, timer
+                )
+                if fixes:
+                    segv = seg.view(np.uint16) if sym == 2 else seg
+                    correct_segment(segv, fixes, row_of)
+                with timer.phase("write output (io)"):
+                    for i in range(k):
+                        if i in row_of:
+                            write_row(i, off, cols, seg[row_of[i]])
+                if dec_missing is not None:
+                    with timer.phase("locate dispatch"), _dispatch_span(
+                        "decode", off, cols
+                    ):
+                        staged = codec.stage_segment(
+                            np.ascontiguousarray(seg[chosen_rows]),
+                            cap=seg_cols // sym, sym=sym,
+                            out_rows=dec_missing.shape[0],
+                        )
+                        rec = codec.decode(dec_missing, staged)
+                    with timer.phase("decode compute"):
+                        rec_np = np.asarray(rec)
+                    if rec_np.dtype != np.uint8:
+                        rec_np = np.ascontiguousarray(rec_np).view(np.uint8)
+                    with timer.phase("write output (io)"):
+                        for i in missing:
+                            write_row(i, off, cols, rec_np[rec_row[i]])
+        out_fp.truncate(scan.total_size)
+        out_fp.close()
+        for fp in fps:
+            fp.close()
+        os.replace(tmp_path, out_path)
+    except BaseException:
+        if not out_fp.closed:
+            out_fp.close()
+        for fp in fps:
+            if not fp.closed:
+                fp.close()
+        if os.path.exists(tmp_path):
+            os.unlink(tmp_path)
+        raise
+    _obs_metrics.quantile(
+        "rs_locate_decode_wall_seconds",
+        "error-locating decode wall seconds (streaming quantiles)",
+    ).observe(time.perf_counter() - t_start)
+    return out_path
 
 
 @_observed_file_op("repair")
@@ -2709,7 +3121,12 @@ def repair_fleet(
 
 
 @_observed_file_op("scan")
-def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> dict:
+def scan_file(
+    in_file: str,
+    *,
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    syndrome: bool = False,
+) -> dict:
     """Read-only archive health report (the scrubbing half of repair).
 
     Returns ``{"k", "p", "w", "checksummed", "healthy", "corrupt",
@@ -2720,8 +3137,46 @@ def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> di
     chunk is repairable.  ``decodable`` is tri-state: ``True`` / ``False``
     / ``"unknown"`` when the subset search hit its cap without a verdict
     (only reachable with pathological non-MDS matrices).
+
+    ``syndrome=True`` (``rs scrub --syndrome``) adds the error-locating
+    pre-check: parity-check syndromes over every present chunk attribute
+    SILENT bitrot — corruption no size check and no CRC would catch — to
+    its chunk index (``state="silent_bitrot"``), without reading a single
+    checksum.  Located chunks are demoted from ``healthy`` into
+    ``corrupt`` and ``decodable`` is re-derived; a verdict of
+    ``unlocatable`` (per-column damage beyond t = floor((p-missing)/2))
+    degrades ``decodable`` to ``"unknown"`` — the erasure math could
+    still rebuild *bytes*, but nothing proves they'd be the right ones.
+    The report gains ``{"syndrome": {"verdict", "silent_bitrot",
+    "symbol_errors", "complete"}}`` — ``complete`` is False when the
+    sweep stopped at an unlocatable segment, in which case
+    ``silent_bitrot`` is a verified-but-PARTIAL attribution (and is not
+    merged into ``corrupt``).
     """
     scan = _scan_chunks(in_file, segment_bytes)
+    syn_report = None
+    if syndrome:
+        verdict, located, nerr, complete = _syndrome_sweep(
+            in_file, scan, segment_bytes=segment_bytes
+        )
+        syn_report = {
+            "verdict": verdict,
+            "silent_bitrot": sorted(located),
+            "symbol_errors": nerr,
+            "complete": complete,
+        }
+        # Demote located chunks only on a COMPLETE attribution: the
+        # unlocatable sweep stops at the first over-t segment, so its
+        # located set covers a prefix of the archive — each entry is
+        # individually verified, but presenting it as the damage set
+        # (and feeding it to repair planning) would understate the rot.
+        if located and verdict == "silent_bitrot":
+            _obs_metrics.counter(
+                "rs_scrub_chunks_total", "chunk verdicts from archive scans"
+            ).labels(state="silent_bitrot").inc(len(located))
+            scan = scan.excluding(
+                {i: chunk_file_name(in_file, i) for i in located}
+            )
     try:
         _select_decodable_subset(scan)
         ok = True
@@ -2729,10 +3184,13 @@ def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> di
         ok = "unknown"
     except ValueError:
         ok = False
+    if syn_report is not None and syn_report["verdict"] == "unlocatable":
+        # Erasure-decodable maybe, but bytes unprovable: not True.
+        ok = "unknown" if ok is True else ok
     _obs_metrics.counter(
         "rs_scrub_verdicts_total", "scan_file decodability verdicts"
     ).labels(decodable=str(ok)).inc()
-    return {
+    report = {
         "k": scan.k,
         "p": scan.p,
         "w": scan.w,
@@ -2742,3 +3200,6 @@ def scan_file(in_file: str, *, segment_bytes: int = DEFAULT_SEGMENT_BYTES) -> di
         "missing": scan.missing,      # absent files
         "decodable": ok,              # decodable implies repairable (one GEMM)
     }
+    if syn_report is not None:
+        report["syndrome"] = syn_report
+    return report

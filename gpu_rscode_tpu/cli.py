@@ -58,11 +58,20 @@ Extensions: [--generator vandermonde|cauchy]
             corrupt ones via CRC32, pick a decodable subset.  Extra
             positional archives after the flags decode a whole batch
             through one shared write-behind lane)
+            [--locate] (decode without -c OR CRCs: error-LOCATING decode
+            — parity-check syndromes find silent bitrot in up to
+            floor((p - missing)/2) chunks per symbol column, patch it,
+            then reconstruct; damage past that bound fails loudly
+            instead of fabricating bytes.  RS_LOCATE=auto|off|force
+            tunes the --auto escalation ladder; docs/RESILIENCE.md)
             [--repair] (with -i: rebuild every lost/corrupt chunk in place,
             parity included; refreshes CRC lines.  Extra positional files
             after the flags repair a whole fleet: all survivor-matrix
             inversions run in one batched device dispatch)
             [--scrub]  (with -i: read-only health report as one JSON line)
+            [--syndrome] (with --scrub: add the error-locating pre-check
+            — syndromes attribute silent bitrot to its chunk index with
+            no CRCs, reported as state "silent_bitrot")
 Observability (docs/OBSERVABILITY.md):
             [--metrics-json PATH] (any operation, --scrub included:
             collect the RS_METRICS registry during the run — enabled
@@ -94,9 +103,11 @@ Subcommands: rs stats [--text] [--workload]
             (merge per-process {path}.p<i> snapshots/traces from a
             multi-host run into one snapshot / one Perfetto file)
             rs chaos [--seed S] [--iters N] [--only I] [--repro JSON]
+            [--silent]
             (seeded encode -> corrupt -> scrub/decode/repair loop,
             differential-checked against the native oracle; failures
-            shrink to a one-line reproducer)
+            shrink to a one-line reproducer.  --silent runs the CRC-less
+            bitrot class recovered by the error-locating decoder)
             rs analyze [--json] [--strategies S,S] [--k K] [--p P]
             [--size-kb N] [--refresh-roofline]
             (roofline attribution: per-strategy achieved GB/s, GFLOP/s,
@@ -427,8 +438,10 @@ def main(argv: list[str] | None = None) -> int:
                 "no-verify",
                 "width=",
                 "auto",
+                "locate",
                 "repair",
                 "scrub",
+                "syndrome",
                 "metrics-json=",
                 "trace=",
                 "faults=",
@@ -461,8 +474,10 @@ def main(argv: list[str] | None = None) -> int:
     no_verify = False
     width = 8
     auto = False
+    locate = False
     repair = False
     scrub = False
+    syndrome = False
     metrics_json = None
     trace_path = None
     faults_spec = None
@@ -518,10 +533,14 @@ def main(argv: list[str] | None = None) -> int:
             width = int(val)
         elif f == "--auto":
             auto = True
+        elif f == "--locate":
+            locate = True
         elif f == "--repair":
             repair = True
         elif f == "--scrub":
             scrub = True
+        elif f == "--syndrome":
+            syndrome = True
         elif f == "--metrics-json":
             metrics_json = val
         elif f == "--trace":
@@ -580,6 +599,25 @@ def main(argv: list[str] | None = None) -> int:
         return _fail("rs: --auto is decode-only")
     if auto and conf_file:
         return _fail("rs: -c and --auto conflict; pick one survivor source")
+    if locate:
+        if op != "decode":
+            return _fail("rs: --locate is decode-only")
+        if conf_file:
+            return _fail(
+                "rs: -c and --locate conflict (locate reads every present "
+                "chunk, no conf needed)"
+            )
+        if auto:
+            return _fail(
+                "rs: --auto and --locate conflict; --auto already "
+                "escalates to locate (RS_LOCATE tunes it)"
+            )
+        if n_devices:
+            return _fail("rs: --locate is single-host; --devices does not apply")
+        if extra:
+            return _fail("rs: --locate decodes one archive at a time")
+    if syndrome and not scrub:
+        return _fail("rs: --syndrome only applies to --scrub")
     if stripe > 1 and not n_devices:
         return _fail("rs: --stripe requires --devices")
     if extra and op in ("encode", "decode"):
@@ -742,6 +780,7 @@ def main(argv: list[str] | None = None) -> int:
 
             report = api.scan_file(
                 in_file,
+                syndrome=syndrome,
                 **(
                     {"segment_bytes": kwargs["segment_bytes"]}
                     if "segment_bytes" in kwargs
@@ -778,9 +817,19 @@ def main(argv: list[str] | None = None) -> int:
                     os.path.getsize(in_file) if os.path.exists(in_file) else 0
                 )
         else:
-            if not in_file or (not conf_file and not auto):
-                return _fail("rs: decoding requires -i and -c (or --auto)")
-            if auto and extra:
+            if not in_file or (not conf_file and not auto and not locate):
+                return _fail(
+                    "rs: decoding requires -i and -c (or --auto/--locate)"
+                )
+            if locate:
+                out = api.locate_decode_file(
+                    in_file, out_file, timer=timer,
+                    **{key: kwargs[key] for key in
+                       ("strategy", "pipeline_depth", "segment_bytes")
+                       if key in kwargs},
+                )
+                nbytes = os.path.getsize(out)
+            elif auto and extra:
                 # Batch decode: -i <first> plus positional archives, one
                 # shared write-behind lane (--devices/-o rejected above).
                 fleet = [in_file] + list(extra)

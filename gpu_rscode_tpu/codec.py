@@ -186,6 +186,17 @@ class RSCodec:
         self._count_segment("decode", chunks)
         return self._matmul(decode_mat, chunks)
 
+    def syndrome(self, check_mat, chunks):
+        """(r, s) parity-check block x (s, m) stacked chunk rows -> (r, m)
+        syndromes (zero columns == consistent codeword columns).
+
+        The error-locating decode path's batched syndrome kernel
+        (gf_decode/syndrome.py): same GF-GEMM machinery as encode/decode —
+        plan-cached, strategy-aware, pallas-guarded — under its own ``op``
+        label so dispatch counts and payload bytes attribute separately."""
+        self._count_segment("syndrome", chunks)
+        return self._matmul(check_mat, chunks)
+
     def stage_segment(self, seg, *, cap=None, sym: int = 1, out_rows=None):
         """Stage one segment for the next encode/decode dispatch.
 

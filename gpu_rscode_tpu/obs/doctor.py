@@ -45,7 +45,7 @@ SCHEMA_VERSION = 1
 
 # The --json document's stable surface (pinned by tests): these keys are
 # always present, whatever the environment looks like.
-SECTIONS = ("python", "jax", "native", "mesh", "env", "ledger",
+SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "ledger",
             "metrics_endpoint", "roofline")
 
 
@@ -103,9 +103,43 @@ def _mesh_section(jax_info: dict) -> dict:
             out["distributed_env"][var] = os.environ[var]
     jax = sys.modules.get("jax")
     if jax is not None:
-        # The carried mesh-failure signature: old jax pins lack
-        # jax.shard_map (docs/STATUS.md, ROADMAP item 4).
-        out["shard_map_available"] = hasattr(jax, "shard_map")
+        # Resolved through the compat shim (parallel/_compat.py): old
+        # jax pins serve jax.experimental.shard_map — the lookup that
+        # carried the 14-test mesh failure set until it was shimmed
+        # (docs/STATUS.md, ROADMAP item 4).
+        try:
+            from ..parallel._compat import shard_map_available
+
+            out["shard_map_available"] = shard_map_available()
+        except Exception:
+            out["shard_map_available"] = hasattr(jax, "shard_map")
+    return out
+
+
+def _decoder_section() -> dict:
+    """Decoder capability matrix (schema-stable): what this build can
+    recover from.  ``erasure`` is the Vandermonde + Gauss-Jordan path the
+    paper ships; ``locate`` is the gf_decode error-locating path (silent
+    bitrot without CRCs — docs/RESILIENCE.md "Error location")."""
+    out: dict = {
+        "erasure": True,
+        "locate": False,
+        "supported_w": [8, 16],
+        "syndrome_kernel": None,
+        "locate_bound": "2*errors + erasures <= n - k per symbol column",
+        "error": None,
+    }
+    try:
+        from .. import gf_decode  # noqa: F401
+        from ..codec import RSCodec
+
+        out["locate"] = True
+        out["syndrome_kernel"] = (
+            "plan-cached GF-GEMM (codec.syndrome)"
+            if hasattr(RSCodec, "syndrome") else None
+        )
+    except Exception as e:  # pragma: no cover - import-degraded env
+        out["error"] = f"{type(e).__name__}: {e}"
     return out
 
 
@@ -208,6 +242,7 @@ def collect(probe_endpoint: bool = True) -> dict:
             k: v for k, v in sorted(os.environ.items())
             if k.startswith("RS_")
         },
+        "decoder": _decoder_section(),
         "ledger": ledger,
         "metrics_endpoint": _endpoint_section(probe_endpoint),
         "roofline": _roofline_section(ledger_records),
@@ -261,6 +296,10 @@ def render(report: dict) -> str:
         "[--] RS_* knobs: "
         + (", ".join(f"{k}={v}" for k, v in report["env"].items())
            or "(none set)"),
+        f"[{mark(report['decoder']['locate'])}] decoder: erasure"
+        + ("+locate" if report["decoder"]["locate"] else " ONLY")
+        + f", w {report['decoder']['supported_w']}, syndrome kernel "
+        + (report["decoder"]["syndrome_kernel"] or "unavailable"),
         f"[{mark(led['writable'])}] ledger: "
         + (f"{led['path']} ({led['records']} records)"
            if led["path"] else "RS_RUNLOG unset"),

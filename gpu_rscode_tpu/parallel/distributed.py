@@ -35,7 +35,9 @@ def initialize(
     """
     import jax
 
-    if jax.distributed.is_initialized():
+    from . import _compat
+
+    if _compat.distributed_is_initialized():
         _mark_telemetry_epoch(jax)
         return  # idempotent: callers (library AND cli) may both invoke this
 
@@ -47,11 +49,15 @@ def initialize(
     if process_id is None and os.environ.get("JAX_PROCESS_ID"):
         process_id = int(os.environ["JAX_PROCESS_ID"])
     if auto or os.environ.get("RS_DISTRIBUTED") == "auto":
+        _compat.enable_cpu_collectives()
         jax.distributed.initialize()
         _mark_telemetry_epoch(jax)
         return
     if coordinator_address is None and num_processes is None and process_id is None:
         return  # single process, nothing configured
+    # CPU-backend multi-process jobs need a collectives layer (gloo)
+    # selected before the client initialises; see parallel/_compat.py.
+    _compat.enable_cpu_collectives()
     jax.distributed.initialize(
         coordinator_address=coordinator_address,
         num_processes=num_processes,

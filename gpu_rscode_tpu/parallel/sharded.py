@@ -34,10 +34,17 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-# check_vma=False: the varying-mesh-axes checker cannot type pallas_call
-# outputs or scan carries initialised inside the body; correctness is
-# covered by the oracle-equality tests on the virtual mesh.
-shard_map = functools.partial(jax.shard_map, check_vma=False)
+# Resolved through the compat shim: jax >= the shard_map promotion serves
+# jax.shard_map (check_vma=False), the 0.4.37 pin serves
+# jax.experimental.shard_map.shard_map (check_rep=False) — see
+# parallel/_compat.py for why the checker is off in both spellings.
+from ._compat import shard_map
+
+if shard_map is None:  # pragma: no cover - no known jax build hits this
+    raise ImportError(
+        "this jax build has no shard_map implementation "
+        "(neither jax.shard_map nor jax.experimental.shard_map)"
+    )
 
 from ..obs import metrics as _metrics, tracing as _tracing
 from ..ops import gemm as _gemm

@@ -15,6 +15,13 @@ bit-reproducible: the same seed yields the same schedule and the same
 pass/fail verdict every run, anywhere (targets are keyed by basename,
 never by temp-dir path).
 
+``--silent`` selects the SILENT corruption class instead
+(:func:`plan_silent_iteration`): CRC-less bitrot proven recovered — or
+refused — by the error-locating decode path (gf_decode/,
+docs/RESILIENCE.md "Error location").  Its schedules derive from their
+own seed stream, so the classic classes' digests are unchanged by its
+existence.
+
 Checks per iteration (any miss is a failure):
 
 * encode differential: every chunk file byte-equals the native oracle's
@@ -74,6 +81,10 @@ _PINNED_ENV = {
     "RS_RETRY_BUDGET": "256",
     "RS_RETRY_RESELECT": "3",
     "RS_RETRY_SUBSET_ATTEMPTS": "3",
+    # The silent class's verdicts hinge on the locate escalation rung:
+    # an ambient RS_LOCATE=off would flip every recoverable silent
+    # iteration to a failure.  Pin the default (auto).
+    "RS_LOCATE": None,
 }
 
 
@@ -107,6 +118,71 @@ class ChaosFailure(Exception):
 
 def _iter_rng(seed: int, i: int) -> random.Random:
     return random.Random(f"rs-chaos:{seed}:{i}")
+
+
+def plan_silent_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
+    """The ``silent`` corruption class: bitrot with CRC verification
+    DISABLED (archives encoded without checksum lines), recovered by the
+    error-locating decode path (gf_decode/, ``rs decode --locate``).
+
+    Schedule grammar: every event is ``{"kind": "silent", "chunk": c,
+    ...}`` with either sparse distinct bit flips (``count``) or a dense
+    random-byte window (``dense: [off, len]``).  Two flavors per seed
+    stream:
+
+    * recoverable — at most ``t = floor(p/2)`` damaged chunks, sparse
+      flips: every symbol column carries <= t errors, so the locate
+      decoder must recover BIT-IDENTICALLY and the syndrome scrub must
+      attribute exactly the damaged chunk set (no CRCs involved);
+    * unrecoverable (> t) — t+1.. chunks damaged over one SHARED dense
+      window with nonzero random bytes: the window's columns all carry
+      > t errors, so decode must FAIL LOUDLY (never fabricate bytes) and
+      the scrub verdict must be ``unlocatable``.
+
+    Deterministic from ``(seed, i)`` on its own derived stream
+    (``rs-chaos-silent:*``) — the classic classes' schedules (seeded
+    from ``rs-chaos:*``) are byte-identical with or without this class
+    existing, so pinned CI seeds keep their verdict digests.
+    """
+    rng = random.Random(f"rs-chaos-silent:{seed}:{i}")
+    k = rng.randint(2, 6)
+    p = rng.randint(2, 4)          # p >= 2: t >= 1, location possible
+    w = 16 if rng.random() < 0.2 else 8
+    size = rng.randint(256, max_bytes)
+    t = p // 2
+    overkill = rng.random() < 0.3
+    if overkill:
+        n_damage = rng.randint(t + 1, min(k + p, p + 2))
+    else:
+        n_damage = rng.randint(0, t)
+    targets = sorted(rng.sample(range(k + p), n_damage))
+    events = []
+    if overkill and targets:
+        # One SHARED window across all victims: those columns all carry
+        # n_damage > t errors — provably past the locate bound.
+        from ..utils.fileformat import chunk_size_for
+
+        chunk = chunk_size_for(size, k, w // 8)
+        ln = max(w // 8, min(chunk, rng.randint(16, 512)))
+        off = rng.randint(0, max(0, chunk - ln))
+        for c in targets:
+            events.append({"kind": "silent", "chunk": c,
+                           "dense": [off, ln]})
+    else:
+        for c in targets:
+            events.append({"kind": "silent", "chunk": c,
+                           "count": rng.randint(1, 12)})
+    return {
+        "seed": seed,
+        "iter": i,
+        "mode": "silent",
+        "k": k,
+        "p": p,
+        "w": w,
+        "size": size,
+        "events": events,
+        "faults": "",
+    }
 
 
 def plan_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
@@ -281,6 +357,24 @@ def _apply_events(fname: str, events, chunk: int, rng: random.Random) -> None:
                 keep = max(0, chunk - 1)
             with open(path, "r+b") as fp:
                 fp.truncate(keep)
+        elif ev["kind"] == "silent":
+            # The silent class (CRC-less bitrot): sparse distinct flips,
+            # or a dense nonzero-random-byte window shared across the
+            # iteration's victims (guarantees > t errors per column in
+            # the unrecoverable flavor).
+            with open(path, "r+b") as fp:
+                buf = bytearray(fp.read())
+                if "dense" in ev:
+                    off, ln = ev["dense"]
+                    for s in range(off, min(off + ln, len(buf))):
+                        buf[s] ^= rng.randint(1, 255)
+                else:
+                    nbits = max(1, len(buf) * 8)
+                    for bit in rng.sample(range(nbits),
+                                          min(ev["count"], nbits)):
+                        buf[bit // 8] ^= 1 << (bit % 8)
+                fp.seek(0)
+                fp.write(bytes(buf))
         else:  # bitrot
             # DISTINCT positions (capped at the chunk's bit count): with
             # replacement, an even number of hits on one bit nets to
@@ -306,7 +400,144 @@ def run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
     (verdicts are a function of the seed alone); returns its outcome
     record or raises :class:`ChaosFailure` with the reproducing config."""
     with _pinned_env():
+        if cfg.get("mode") == "silent":
+            return _run_silent_iteration(cfg, workdir, keep=keep)
         return _run_iteration(cfg, workdir, keep=keep)
+
+
+def _run_silent_iteration(cfg: dict, workdir: str, *,
+                          keep: bool = False) -> dict:
+    """One ``silent``-class iteration: encode WITHOUT checksum lines,
+    corrupt per schedule, then prove the error-locating plane's contract
+    (docs/RESILIENCE.md "Error location"):
+
+    * <= t damaged chunks: the syndrome scrub attributes EXACTLY the
+      damaged set (no CRCs anywhere), and both the auto-decode escalation
+      ladder and ``locate_decode_file`` recover bit-identical bytes;
+    * > t: the scrub verdict is ``unlocatable``, ``decodable`` degrades
+      to ``"unknown"``, and every decode path raises — never a silently
+      wrong output.
+    """
+    from .. import api
+    from ..utils.fileformat import (
+        chunk_file_name, chunk_size_for, metadata_file_name,
+        read_metadata_ext,
+    )
+
+    seed, i = cfg["seed"], cfg["iter"]
+    k, p, w, size = cfg["k"], cfg["p"], cfg["w"], cfg["size"]
+    rng = random.Random(f"rs-chaos-silent-run:{seed}:{i}")
+    base = os.path.join(workdir, f"iter{i}")
+    os.makedirs(base, exist_ok=True)
+    fname = os.path.join(base, f"chaos_silent_{i}.bin")
+    data = random.Random(f"rs-chaos-data:{seed}:{i}").randbytes(size)
+    ok = False
+    try:
+        with open(fname, "wb") as fp:
+            fp.write(data)
+        api.encode_file(
+            fname, k, p, checksums=False, w=w, segment_bytes=_SEGMENT_BYTES
+        )
+        total_size, p_m, k_m, total_mat, w_m, crcs = read_metadata_ext(
+            metadata_file_name(fname)
+        )
+        _check((k_m, p_m, w_m, total_size) == (k, p, w, size), cfg,
+               "metadata disagrees with the encode config")
+        _check(not crcs, cfg, "silent-class archive must carry no CRCs")
+        oracle = _oracle_chunks(data, k, p, w, total_mat)
+        for c in range(k + p):
+            got = open(chunk_file_name(fname, c), "rb").read()
+            _check(got == oracle[c], cfg,
+                   f"encode differential mismatch on chunk {c}")
+
+        chunk = chunk_size_for(size, k, w // 8)
+        _apply_events(fname, cfg["events"], chunk, rng)
+        damaged = sorted({ev["chunk"] for ev in cfg["events"]})
+        t = p // 2
+        recoverable = len(damaged) <= t
+
+        _retry.reset_budget()
+        report = api.scan_file(
+            fname, syndrome=True, segment_bytes=_SEGMENT_BYTES
+        )
+        syn = report["syndrome"]
+        if recoverable:
+            _check(
+                syn["verdict"] == ("silent_bitrot" if damaged else "clean"),
+                cfg, f"scrub syndrome verdict {syn['verdict']!r} for "
+                f"damage {damaged}",
+            )
+            # The attribution contract: chunk indices pinned WITHOUT CRCs
+            # (the syndrome pre-check replacing subset-search oracling as
+            # the first line of damage attribution).
+            _check(syn["silent_bitrot"] == damaged, cfg,
+                   f"syndrome attributed {syn['silent_bitrot']}, "
+                   f"schedule damaged {damaged}")
+            _check(report["decodable"] is True, cfg,
+                   f"decodable {report['decodable']} on <=t silent damage")
+            out = api.auto_decode_file(
+                fname, fname + ".dec", segment_bytes=_SEGMENT_BYTES
+            )
+            _check(open(out, "rb").read() == data, cfg,
+                   "auto-decode (locate rung) output != original bytes")
+            out2 = api.locate_decode_file(
+                fname, fname + ".dec2", segment_bytes=_SEGMENT_BYTES
+            )
+            _check(open(out2, "rb").read() == data, cfg,
+                   "locate decode output != original bytes")
+        else:
+            _check(syn["verdict"] == "unlocatable", cfg,
+                   f"scrub syndrome verdict {syn['verdict']!r} on >t "
+                   "silent damage")
+            _check(report["decodable"] == "unknown", cfg,
+                   "decodable must degrade to 'unknown' past the t bound")
+            for op_name, call in (
+                ("auto_decode", lambda: api.auto_decode_file(
+                    fname, fname + ".dec", segment_bytes=_SEGMENT_BYTES)),
+                ("locate_decode", lambda: api.locate_decode_file(
+                    fname, fname + ".dec2",
+                    segment_bytes=_SEGMENT_BYTES)),
+            ):
+                try:
+                    call()
+                    _check(False, cfg,
+                           f"{op_name} succeeded on >t silent damage")
+                except ValueError:
+                    pass  # UnlocatableError is the expected subclass
+            # Never half-written: decode failures must leave no output.
+            for leftover in (fname + ".dec", fname + ".dec2"):
+                _check(not os.path.exists(leftover), cfg,
+                       f"failed decode left {leftover}")
+        ok = True
+    except ChaosFailure:
+        raise
+    except Exception as e:
+        raise ChaosFailure(
+            cfg, f"unexpected {type(e).__name__}: {e}"
+        ) from e
+    finally:
+        verdict = "pass" if ok else "fail"
+        _metrics.counter(
+            "rs_chaos_iterations_total", "chaos-harness iteration verdicts"
+        ).labels(verdict=verdict).inc()
+        if _runlog.enabled():
+            _runlog.record({
+                "op": "chaos_iter",
+                "config": {"k": k, "n": k + p, "w": w},
+                "bytes": size,
+                "chaos": {
+                    "seed": seed, "iter": i, "mode": "silent",
+                    "events": cfg["events"], "faults": cfg["faults"],
+                },
+                "outcome": "ok" if ok else "error",
+            })
+        if ok and not keep:
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "iter": i, "mode": "silent", "k": k, "p": p, "w": w, "size": size,
+        "damaged": sorted({ev["chunk"] for ev in cfg["events"]}),
+        "faults": cfg["faults"], "verdict": "pass",
+    }
 
 
 def _run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
@@ -510,6 +741,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="work directory (default: a fresh temp dir)")
     ap.add_argument("--max-bytes", type=int, default=49152,
                     help="max file size per iteration (default 48 KiB)")
+    ap.add_argument("--silent", action="store_true",
+                    help="run the SILENT corruption class: CRC-less "
+                    "bitrot recovered (or refused) by the error-locating "
+                    "decode path — own seed stream, classic schedules "
+                    "unchanged")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line per iteration")
     ap.add_argument("--keep", action="store_true",
@@ -533,9 +769,8 @@ def main(argv: list[str] | None = None) -> int:
             return 2
     else:
         indices = [args.only] if args.only is not None else range(args.iters)
-        cfgs = [
-            plan_iteration(args.seed, i, args.max_bytes) for i in indices
-        ]
+        plan = plan_silent_iteration if args.silent else plan_iteration
+        cfgs = [plan(args.seed, i, args.max_bytes) for i in indices]
     schedule_digest = _digest(cfgs)
 
     results = []
@@ -548,9 +783,12 @@ def main(argv: list[str] | None = None) -> int:
             )
             line = json.dumps(shrunk, sort_keys=True)
             print(f"rs chaos: FAILED — {e.what}", file=sys.stderr)
+            silent_flag = (
+                "--silent " if cfg.get("mode") == "silent" else ""
+            )
             print(
                 f"rs chaos: replay the original with: rs chaos "
-                f"--seed {cfg['seed']} --only {cfg['iter']}",
+                f"{silent_flag}--seed {cfg['seed']} --only {cfg['iter']}",
                 file=sys.stderr,
             )
             print(f"REPRODUCE: {line}")

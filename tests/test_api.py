@@ -644,7 +644,6 @@ def test_scan_file_decodable_unknown_when_capped(tmp_path, monkeypatch):
 # ----- mesh-sharded file layer ----------------------------------------------
 
 
-@pytest.mark.mesh_known_failure
 def test_mesh_sharded_file_roundtrip_matches_single_device(tmp_path):
     """encode_file over an 8-device (cols) mesh must write byte-identical
     chunks to the single-device path, and decode over the mesh recovers."""
@@ -667,7 +666,6 @@ def test_mesh_sharded_file_roundtrip_matches_single_device(tmp_path):
 
 
 @pytest.mark.parametrize("strategy", ["auto", "pallas"])
-@pytest.mark.mesh_known_failure
 def test_stripe_sharded_file_roundtrip(tmp_path, strategy):
     """Wide-stripe mode end-to-end at the file layer: the k axis sharded
     over 2 devices, psum carrying the XOR accumulation.  strategy='pallas'
@@ -685,7 +683,6 @@ def test_stripe_sharded_file_roundtrip(tmp_path, strategy):
 
 
 @pytest.mark.parametrize("stripe", [1, 2])
-@pytest.mark.mesh_known_failure
 def test_mesh_repair_byte_identical(tmp_path, stripe):
     """Archive repair fans out over the mesh (the reference's multi-GPU
     decode analog, decode.cu:335-378): rebuilt chunks must be byte-identical
@@ -710,7 +707,6 @@ def test_mesh_repair_byte_identical(tmp_path, stripe):
         assert open(chunk_file_name(path, i), "rb").read() == golden[i], i
 
 
-@pytest.mark.mesh_known_failure
 def test_mesh_auto_decode_roundtrip(tmp_path):
     """auto-decode with the GEMM sharded over the mesh: deleted + corrupt
     chunks excluded, file recovered bit-exactly."""

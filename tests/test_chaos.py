@@ -88,6 +88,61 @@ def test_unrecoverable_damage_expected(tmp_path):
     assert rec["damaged"] == [0, 2]
 
 
+def test_silent_schedule_is_independent_stream():
+    """The silent class derives from its own seed stream: classic
+    schedules are byte-identical with or without it (pinned CI seeds keep
+    their digests), and the silent schedule is itself pure."""
+    classic = [chaos.plan_iteration(20260819, i) for i in range(5)]
+    assert classic == [chaos.plan_iteration(20260819, i) for i in range(5)]
+    a = [chaos.plan_silent_iteration(9, i) for i in range(6)]
+    assert a == [chaos.plan_silent_iteration(9, i) for i in range(6)]
+    assert all(c["mode"] == "silent" for c in a)
+    assert all(ev["kind"] == "silent" for c in a for ev in c["events"])
+
+
+def test_silent_recoverable_iteration_passes(tmp_path):
+    """A <= t silent-bitrot config runs the locate contract end to end:
+    syndrome attribution + bit-identical recovery, no CRCs anywhere."""
+    cfg = {
+        "seed": 5, "iter": 0, "mode": "silent", "k": 4, "p": 3, "w": 8,
+        "size": 9000,
+        "events": [{"kind": "silent", "chunk": 2, "count": 6}],
+        "faults": "",
+    }
+    rec = chaos.run_iteration(cfg, str(tmp_path / "run"))
+    assert rec["verdict"] == "pass" and rec["damaged"] == [2]
+
+
+def test_silent_overkill_iteration_refuses(tmp_path):
+    """> t silent damage must be a verified REFUSAL (unlocatable scrub
+    verdict, failing decodes) — the never-silently-wrong contract."""
+    cfg = {
+        "seed": 5, "iter": 1, "mode": "silent", "k": 3, "p": 2, "w": 8,
+        "size": 8000,
+        "events": [
+            {"kind": "silent", "chunk": 0, "dense": [40, 200]},
+            {"kind": "silent", "chunk": 1, "dense": [40, 200]},
+        ],
+        "faults": "",
+    }
+    rec = chaos.run_iteration(cfg, str(tmp_path / "run"))
+    assert rec["verdict"] == "pass" and rec["damaged"] == [0, 1]
+
+
+def test_cli_silent_smoke_reproducible(tmp_path, capsys):
+    def run(sub):
+        rc = chaos.main([
+            "--silent", "--seed", "20260804", "--iters", "3",
+            "--dir", str(tmp_path / sub),
+        ])
+        assert rc == 0
+        return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+    first, second = run("a"), run("b")
+    assert first["verdict_digest"] == second["verdict_digest"]
+    assert first["passed"] == 3
+
+
 def test_cli_pass_and_only(tmp_path, capsys):
     rc = chaos.main([
         "--seed", "11", "--iters", "2", "--dir", str(tmp_path / "w"),

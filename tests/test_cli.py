@@ -102,7 +102,6 @@ def test_cli_scrub_reports_health(tmp_path, capsys):
     assert not report["decodable"]
 
 
-@pytest.mark.mesh_known_failure
 def test_cli_devices_roundtrip(tmp_path):
     import numpy as np
 
@@ -124,7 +123,6 @@ def test_cli_devices_roundtrip(tmp_path):
     assert open(out, "rb").read() == data
 
 
-@pytest.mark.mesh_known_failure
 def test_cli_repair_on_mesh(tmp_path):
     """--repair accepts --devices now (round-1 VERDICT: lift the
     single-device restriction on the maintenance paths)."""

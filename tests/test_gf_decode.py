@@ -1,0 +1,414 @@
+"""Error-locating decode (gf_decode/): solver soundness, syndrome
+attribution, file-level locate decode, the auto-decode escalation
+ladder, scrub --syndrome / decode --locate CLI surface, and the
+never-silently-wrong contract past the t bound."""
+
+import os
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api, gf_decode
+from gpu_rscode_tpu.cli import main as cli_main
+from gpu_rscode_tpu.gf_decode import (
+    LocateContext,
+    UnlocatableError,
+    berlekamp_massey,
+    correct_segment,
+    erasure_reduced_check,
+    gf_solve,
+    locate_segment,
+    parity_check_matrix,
+    vandermonde_points,
+)
+from gpu_rscode_tpu.models.vandermonde import cauchy_matrix, total_matrix
+from gpu_rscode_tpu.ops.gf import get_field
+from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+
+# ----- solver units ----------------------------------------------------------
+
+
+def _codeword(T, k, X, gf):
+    return np.concatenate([X, gf.matmul(T[k:], X)], axis=0).astype(np.int64)
+
+
+def test_parity_check_annihilates_the_code():
+    for w in (8, 16):
+        gf = get_field(w)
+        for k, p in ((2, 2), (5, 3), (8, 4)):
+            T = total_matrix(p, k, gf)
+            H = parity_check_matrix(T, k, gf)
+            assert H.shape == (p, k + p)
+            assert not gf.matmul(H, T).any()
+
+
+def test_parity_check_rejects_non_systematic():
+    gf = get_field(8)
+    T = total_matrix(3, 4, gf).copy()
+    T[0, 0] = 7  # break the identity block
+    with pytest.raises(ValueError, match="systematic"):
+        parity_check_matrix(T, 4, gf)
+
+
+def test_vandermonde_points_detection():
+    gf = get_field(8)
+    T = total_matrix(3, 5, gf)
+    pts = vandermonde_points(T, 5, gf)
+    np.testing.assert_array_equal(pts, np.arange(1, 6))
+    Tc = np.concatenate(
+        [np.eye(5, dtype=np.uint8), cauchy_matrix(3, 5, gf)], axis=0
+    )
+    assert vandermonde_points(Tc, 5, gf) is None
+
+
+def test_gf_solve_roundtrip_and_refusals():
+    gf = get_field(8)
+    rng = np.random.default_rng(3)
+    A = rng.integers(1, 256, size=(4, 2), dtype=np.uint8)
+    x = np.array([7, 99], dtype=np.int64)
+    b = np.zeros(4, dtype=np.int64)
+    for j in range(2):
+        b ^= gf.mul(int(x[j]), A[:, j].astype(np.int64)).astype(np.int64)
+    got = gf_solve(A, b, gf)
+    np.testing.assert_array_equal(got, x)
+    # inconsistent rhs is refused, not force-fit
+    assert gf_solve(A, b ^ 1, gf) is None
+    # rank-deficient (duplicate columns) is refused: ambiguous support
+    assert gf_solve(np.stack([A[:, 0], A[:, 0]], axis=1), b, gf) is None
+
+
+def test_berlekamp_massey_recovers_locator_roots():
+    gf = get_field(8)
+    pts = np.arange(1, 11, dtype=np.int64)  # native points of k=10
+    rng = np.random.default_rng(5)
+    for e in (1, 2, 3):
+        locs = sorted(rng.choice(10, size=e, replace=False))
+        mags = rng.integers(1, 256, size=e)
+        p = 2 * e  # just enough syndrome rows
+        S = [
+            int(
+                np.bitwise_xor.reduce(
+                    gf.mul(mags, gf.pow(pts[locs], j)).astype(np.int64)
+                )
+            )
+            for j in range(p)
+        ]
+        C, L = berlekamp_massey(S, gf)
+        assert L == e
+        from gpu_rscode_tpu.gf_decode.bw import _chien_roots
+
+        assert _chien_roots(C, pts, gf) == locs
+
+
+@pytest.mark.parametrize("w", [8, 16])
+@pytest.mark.parametrize("generator", ["vandermonde", "cauchy"])
+def test_locate_segment_exact_up_to_t(w, generator):
+    """<= t random errors per column: located and corrected exactly, for
+    both generators (BM fast path and general search) and both widths."""
+    gf = get_field(w)
+    rng = np.random.default_rng(w)
+    k, p, m = 6, 4, 50
+    if generator == "vandermonde":
+        T = total_matrix(p, k, gf)
+    else:
+        T = np.concatenate(
+            [np.eye(k, dtype=gf.dtype), cauchy_matrix(p, k, gf)], axis=0
+        )
+    H = parity_check_matrix(T, k, gf)
+    pts = vandermonde_points(T, k, gf)
+    X = rng.integers(0, gf.size, size=(k, m)).astype(gf.dtype)
+    Y = _codeword(T, k, X, gf)
+    E = np.zeros_like(Y)
+    for col in range(0, m, 5):
+        for row in rng.choice(k + p, size=int(rng.integers(1, 3)),
+                              replace=False):
+            E[row, col] ^= int(rng.integers(1, gf.size))
+    Yc = Y ^ E
+    S = gf.matmul(H, Yc).astype(np.int64)
+    corr = locate_segment(S, H.astype(np.int64), gf, points=pts)
+    for col, fixes in corr.items():
+        for pos, mag in fixes:
+            Yc[pos, col] ^= mag
+    np.testing.assert_array_equal(Yc, Y)
+
+
+def test_locate_segment_flags_past_t():
+    """t+1 dense errors per column raise UnlocatableError (p=3, t=1,
+    e=2 < d-t: detection is GUARANTEED, not probabilistic)."""
+    gf = get_field(8)
+    k, p, m = 5, 3, 20
+    T = total_matrix(p, k, gf)
+    H = parity_check_matrix(T, k, gf)
+    rng = np.random.default_rng(0)
+    X = rng.integers(0, 256, size=(k, m), dtype=np.uint8)
+    Y = _codeword(T, k, X, gf)
+    Y[0] ^= int(rng.integers(1, 256))
+    Y[1] ^= int(rng.integers(1, 256))
+    S = gf.matmul(H, Y).astype(np.int64)
+    with pytest.raises(UnlocatableError):
+        locate_segment(S, H.astype(np.int64), gf,
+                       points=vandermonde_points(T, k, gf))
+
+
+def test_erasure_reduction_and_context_budget():
+    gf = get_field(8)
+    k, p = 4, 4
+    T = total_matrix(p, k, gf)
+    H = parity_check_matrix(T, k, gf)
+    Hp = erasure_reduced_check(H, [1, 6], gf)
+    assert Hp.shape[0] == p - 2 and not Hp[:, [1, 6]].any()
+    ctx = LocateContext(T, k, p, 8, [0, 2, 3, 4, 5, 7])
+    assert ctx.t == 1 and ctx.r == 2 and ctx.erasures == [1, 6]
+    assert erasure_reduced_check(H, [0, 1, 2, 3, 4], gf) is None  # nu > p
+    with pytest.raises(ValueError, match="exceeds parity"):
+        LocateContext(T, k, p, 8, [0, 1, 2])
+
+
+# ----- file-level locate decode ---------------------------------------------
+
+
+def _mkarchive(tmp_path, name, k, p, *, w=8, size=30000, seed=0,
+               checksums=False):
+    path = str(tmp_path / name)
+    rng = np.random.default_rng(seed)
+    data = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    open(path, "wb").write(data)
+    api.encode_file(path, k, p, w=w, checksums=checksums,
+                    segment_bytes=4096)
+    return path, data
+
+
+def _rot(path, chunk_idx, positions):
+    p_ = chunk_file_name(path, chunk_idx)
+    buf = bytearray(open(p_, "rb").read())
+    for bit in positions:
+        bit %= len(buf) * 8
+        buf[bit // 8] ^= 1 << (bit % 8)
+    open(p_, "wb").write(bytes(buf))
+
+
+@pytest.mark.parametrize("w", [8, 16])
+@pytest.mark.parametrize("strategy", ["bitplane", "table", "pallas", "cpu"])
+def test_scrub_syndrome_attributes_single_chunk_bitrot(tmp_path, w,
+                                                       strategy):
+    """The acceptance surface: seeded single-chunk bitrot WITHOUT CRCs is
+    attributed to its chunk index by the syndrome pre-check, across both
+    widths and every host-safe GF strategy."""
+    if strategy == "cpu" and w == 16:
+        pytest.skip("native host codec is w=8-only by contract")
+    path, _ = _mkarchive(tmp_path, f"a{w}{strategy}.bin", 4, 3, w=w,
+                         seed=w)
+    _rot(path, 2, (17, 4001, 90001))
+    scan = api._scan_chunks(path, 4096)
+    verdict, located, nerr, complete = api._syndrome_sweep(
+        path, scan, strategy=strategy, segment_bytes=4096
+    )
+    assert verdict == "silent_bitrot"
+    assert located == {2}
+    assert nerr >= 1 and complete
+
+
+def test_scan_file_syndrome_report_and_plain_scan_blindness(tmp_path):
+    path, _ = _mkarchive(tmp_path, "b.bin", 4, 3)
+    _rot(path, 5, (8, 900))
+    plain = api.scan_file(path, segment_bytes=4096)
+    assert plain["corrupt"] == [] and plain["decodable"] is True
+    rep = api.scan_file(path, syndrome=True, segment_bytes=4096)
+    assert rep["syndrome"]["verdict"] == "silent_bitrot"
+    assert rep["syndrome"]["silent_bitrot"] == [5]
+    assert 5 in rep["corrupt"] and 5 not in rep["healthy"]
+    assert rep["decodable"] is True  # one bad chunk of p=3: repairable
+
+
+def test_scan_file_syndrome_clean_archive(tmp_path):
+    path, _ = _mkarchive(tmp_path, "c.bin", 3, 2)
+    rep = api.scan_file(path, syndrome=True, segment_bytes=4096)
+    assert rep["syndrome"] == {
+        "verdict": "clean", "silent_bitrot": [], "symbol_errors": 0,
+        "complete": True,
+    }
+
+
+def test_scan_file_unlocatable_partial_attribution_not_merged(tmp_path):
+    """Past the t bound the sweep stops early: its partial located set is
+    reported (complete=False) but NOT merged into corrupt — a prefix
+    attribution must not masquerade as the damage set."""
+    path, _ = _mkarchive(tmp_path, "q.bin", 4, 2, seed=15)  # t = 1
+    rng = np.random.default_rng(4)
+    for c in (1, 2):
+        p_ = chunk_file_name(path, c)
+        buf = np.frombuffer(open(p_, "rb").read(), dtype=np.uint8).copy()
+        buf[20:500] ^= rng.integers(1, 256, size=480, dtype=np.uint8)
+        open(p_, "wb").write(buf.tobytes())
+    rep = api.scan_file(path, syndrome=True, segment_bytes=4096)
+    assert rep["syndrome"]["verdict"] == "unlocatable"
+    assert rep["syndrome"]["complete"] is False
+    assert rep["corrupt"] == []
+    assert rep["decodable"] == "unknown"
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_locate_decode_recovers_bitrot_bit_exact(tmp_path, w):
+    path, data = _mkarchive(tmp_path, f"d{w}.bin", 4, 3, w=w, seed=3)
+    _rot(path, 1, (5, 7777, 123456))
+    out = api.locate_decode_file(path, path + ".dec", segment_bytes=4096)
+    assert open(out, "rb").read() == data
+
+
+def test_locate_decode_composes_erasure_and_error(tmp_path):
+    """One chunk missing (erasure) + bitrot in another: the reduced check
+    still locates within t' = (p - 1) // 2."""
+    path, data = _mkarchive(tmp_path, "e.bin", 4, 3, seed=4)
+    os.unlink(chunk_file_name(path, 0))
+    _rot(path, 3, (99, 40000))
+    out = api.locate_decode_file(path, path + ".dec", segment_bytes=4096)
+    assert open(out, "rb").read() == data
+
+
+def test_locate_decode_clean_archive_identity(tmp_path):
+    path, data = _mkarchive(tmp_path, "f.bin", 5, 2, seed=5)
+    out = api.locate_decode_file(path, path + ".dec", segment_bytes=4096)
+    assert open(out, "rb").read() == data
+
+
+def test_locate_decode_flags_past_t_and_leaves_no_output(tmp_path):
+    path, data = _mkarchive(tmp_path, "g.bin", 4, 2, seed=6)  # t = 1
+    rng = np.random.default_rng(1)
+    for c in (0, 1):
+        p_ = chunk_file_name(path, c)
+        buf = np.frombuffer(open(p_, "rb").read(), dtype=np.uint8).copy()
+        buf[50:400] ^= rng.integers(1, 256, size=350, dtype=np.uint8)
+        open(p_, "wb").write(buf.tobytes())
+    with pytest.raises(UnlocatableError):
+        api.locate_decode_file(path, path + ".dec", segment_bytes=4096)
+    assert not os.path.exists(path + ".dec")
+    assert not os.path.exists(path + ".dec.rs_tmp")
+
+
+def test_auto_decode_escalates_to_locate_without_crcs(tmp_path):
+    """The ladder's CRC-off first line: a non-checksummed archive with
+    silent bitrot auto-decodes bit-exact through the locate rung."""
+    path, data = _mkarchive(tmp_path, "h.bin", 4, 3, seed=7)
+    _rot(path, 2, (1234, 60000))
+    out = api.auto_decode_file(path, str(tmp_path / "o"),
+                               segment_bytes=4096)
+    assert open(out, "rb").read() == data
+
+
+def test_auto_decode_locate_off_knob(tmp_path, monkeypatch):
+    """RS_LOCATE=off restores the old (silently wrong) erasure behavior
+    — the knob exists exactly so deployments can opt out."""
+    monkeypatch.setenv("RS_LOCATE", "off")
+    path, data = _mkarchive(tmp_path, "i.bin", 4, 3, seed=8)
+    _rot(path, 0, (9,))  # native chunk: flips straight into the output
+    out = api.auto_decode_file(path, str(tmp_path / "o"),
+                               segment_bytes=4096)
+    assert open(out, "rb").read() != data  # documented blindness
+
+
+def test_auto_decode_crc_archives_keep_erasure_path(tmp_path):
+    """CRC-verified archives stay on the erasure ladder (locate never
+    engages): CRC catches the rot, reselect routes around it."""
+    path, data = _mkarchive(tmp_path, "j.bin", 4, 3, checksums=True,
+                            seed=9)
+    _rot(path, 1, (44,))
+    out = api.auto_decode_file(path, str(tmp_path / "o"),
+                               segment_bytes=4096)
+    assert open(out, "rb").read() == data
+    # scan-driven exclusion, not syndrome correction, handled it
+    rep = api.scan_file(path, segment_bytes=4096)
+    assert rep["corrupt"] == [1]
+
+
+def test_auto_decode_past_t_raises_not_silently_wrong(tmp_path):
+    path, _ = _mkarchive(tmp_path, "k.bin", 4, 2, seed=10)  # t = 1
+    rng = np.random.default_rng(2)
+    for c in (2, 3):
+        p_ = chunk_file_name(path, c)
+        buf = np.frombuffer(open(p_, "rb").read(), dtype=np.uint8).copy()
+        buf[10:300] ^= rng.integers(1, 256, size=290, dtype=np.uint8)
+        open(p_, "wb").write(buf.tobytes())
+    with pytest.raises(UnlocatableError):
+        api.auto_decode_file(path, str(tmp_path / "o"), segment_bytes=4096)
+
+
+def test_locate_decode_metrics_series(tmp_path, monkeypatch):
+    from gpu_rscode_tpu.obs import metrics
+
+    metrics.force_enable()
+    try:
+        metrics.REGISTRY.reset()
+        path, data = _mkarchive(tmp_path, "m.bin", 4, 3, seed=11)
+        _rot(path, 4, (3, 999))
+        out = api.locate_decode_file(path, path + ".dec",
+                                     segment_bytes=4096)
+        assert open(out, "rb").read() == data
+        snap = metrics.REGISTRY.snapshot()
+        checks = snap["rs_syndrome_checks_total"]["values"]
+        assert any("silent_bitrot" in key for key in checks)
+        located = snap["rs_located_errors_total"]["values"]
+        assert sum(located.values()) >= 1
+        assert "rs_locate_decode_wall_seconds" in snap
+    finally:
+        metrics.REGISTRY.reset()
+        metrics.force_enable(False)
+
+
+# ----- CLI surface -----------------------------------------------------------
+
+
+def test_cli_decode_locate_roundtrip(tmp_path, capsys):
+    path, data = _mkarchive(tmp_path, "n.bin", 4, 3, seed=12)
+    _rot(path, 2, (500,))
+    out = str(tmp_path / "out.bin")
+    assert cli_main(["-d", "--locate", "-i", path, "-o", out,
+                     "--quiet"]) == 0
+    assert open(out, "rb").read() == data
+
+
+def test_cli_scrub_syndrome_flag(tmp_path, capsys):
+    import json
+
+    path, _ = _mkarchive(tmp_path, "o.bin", 4, 3, seed=13)
+    _rot(path, 1, (64,))
+    assert cli_main(["--scrub", "--syndrome", "-i", path]) == 0
+    rep = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rep["syndrome"]["silent_bitrot"] == [1]
+
+
+def test_cli_locate_flag_validation(tmp_path, capsys):
+    assert cli_main(["--scrub", "--locate", "-i", "x"]) == 2
+    assert cli_main(["-d", "--locate", "--auto", "-i", "x"]) == 2
+    assert cli_main(["-d", "--locate", "-c", "conf", "-i", "x"]) == 2
+    assert cli_main(["--syndrome", "-k", "2", "-n", "4", "-e", "x"]) == 2
+    capsys.readouterr()
+
+
+def test_cli_locate_unlocatable_exits_nonzero(tmp_path, capsys):
+    path, _ = _mkarchive(tmp_path, "p.bin", 4, 2, seed=14)
+    rng = np.random.default_rng(3)
+    for c in (0, 4):
+        p_ = chunk_file_name(path, c)
+        buf = np.frombuffer(open(p_, "rb").read(), dtype=np.uint8).copy()
+        buf[0:256] ^= rng.integers(1, 256, size=256, dtype=np.uint8)
+        open(p_, "wb").write(buf.tobytes())
+    assert cli_main(["-d", "--locate", "-i", path, "-o",
+                     str(tmp_path / "o"), "--quiet"]) == 1
+    capsys.readouterr()
+
+
+# ----- doctor capability surface --------------------------------------------
+
+
+def test_doctor_reports_decoder_capabilities(capsys):
+    import json
+
+    assert cli_main(["doctor", "--json", "--no-probe"]) == 0
+    rep = json.loads(capsys.readouterr().out.strip())
+    dec = rep["decoder"]
+    assert dec["erasure"] is True
+    assert dec["locate"] is True
+    assert dec["supported_w"] == [8, 16]
+    assert "codec.syndrome" in dec["syndrome_kernel"]
+    assert gf_decode is not None  # the capability it reports on

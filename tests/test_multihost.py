@@ -25,6 +25,13 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
+# The LAST carried mesh known-failure (13 of the original 14 were fixed
+# by the parallel/_compat.py shard_map shim): with shard_map resolved and
+# gloo CPU collectives enabled, the 2-process workers now get through
+# init and real collectives, but the pinned jaxlib 0.4.37's gloo TCP
+# transport crashes deterministically on >~30 KB messages
+# ("op.preamble.length <= op.nbytes") — a jaxlib bug, not ours.  Burn-down
+# needs a jaxlib bump; inventory in docs/STATUS.md.
 @pytest.mark.mesh_known_failure
 def test_two_process_sharded_gemm(tmp_path):
     port = _free_port()

@@ -175,7 +175,6 @@ def test_encode_failure_atomic(tmp_path, monkeypatch):
 
 
 @pytest.mark.parametrize("stripe", [1, 2])
-@pytest.mark.mesh_known_failure
 def test_file_roundtrip_on_mesh(tmp_path, stripe):
     """Full file encode/decode with segments sharded over the 8-device mesh
     (stripe=2 exercises the psum path end-to-end through the file API)."""
@@ -241,7 +240,6 @@ def test_sync_vs_writebehind_deterministic(tmp_path, monkeypatch):
     assert runs["0"] == runs["2"]
 
 
-@pytest.mark.mesh_known_failure
 def test_mesh_output_identical_to_single(tmp_path):
     from gpu_rscode_tpu.utils.fileformat import chunk_file_name
 

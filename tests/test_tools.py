@@ -90,7 +90,6 @@ def test_io_bench_smoke():
     assert all(d["serial"] > 0 and d["threads8"] > 0 for d in got)
 
 
-@pytest.mark.mesh_known_failure
 def test_mesh_bench_smoke():
     got = _run_tool(
         "gpu_rscode_tpu.tools.mesh_bench", "--mb", "2", "--trials", "1",
@@ -102,7 +101,6 @@ def test_mesh_bench_smoke():
                ("cols_pallas", "stripe_pallas", "cols_bitplane")), res
 
 
-@pytest.mark.mesh_known_failure
 def test_mesh_overhead_smoke():
     got = _run_tool(
         "gpu_rscode_tpu.tools.mesh_overhead",
