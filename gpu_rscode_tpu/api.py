@@ -267,6 +267,52 @@ def _staging_ring(
     )
 
 
+def warm_plan(
+    native_num: int,
+    parity_num: int,
+    *,
+    w: int = 8,
+    generator: str = "vandermonde",
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    file_bytes: int | None = None,
+) -> dict:
+    """Pre-compile the encode executable for one plan-cache shape bucket.
+
+    The residency hook (docs/SERVE.md): a resident process — the serve
+    daemon at startup (``rs serve --warm k,n``), a long-lived embedder —
+    pays the AOT compile HERE instead of inside its first real request.
+    Stages one zero segment of the bucket the workload will hit
+    (``file_bytes`` sizes it like :func:`encode_file` would; default one
+    full segment) and blocks until the dispatch lands in the shared plan
+    cache, where every later :func:`encode_file`/:func:`encode_fleet`
+    with the same ``(k, p, w, strategy)`` shape finds it warm.  Returns
+    the resolved shape (strategy ``auto`` pinned to its backend choice).
+    """
+    if w not in (8, 16):
+        raise ValueError(f"file-layer symbol width must be 8 or 16, got {w}")
+    sym = w // 8
+    codec = RSCodec(
+        native_num, parity_num, w=w, generator=generator, strategy=strategy
+    )
+    chunk = (
+        chunk_size_for(file_bytes, native_num, sym)
+        if file_bytes else max(sym, segment_bytes)
+    )
+    seg_cols = _segment_cols(chunk, native_num, segment_bytes)
+    seg = np.zeros((native_num, seg_cols), dtype=np.uint8)
+    staged = codec.stage_segment(
+        seg, cap=seg_cols // sym, sym=sym,
+        out_rows=codec.parity_block.shape[0],
+    )
+    np.asarray(codec.encode(staged))  # block: the compile is now cached
+    return {
+        "k": native_num, "p": parity_num, "w": w,
+        "strategy": codec.strategy, "generator": generator,
+        "cols": seg_cols,
+    }
+
+
 @contextmanager
 def _fleet_lane():
     """The fleet scaffold every multi-file entry point shares: one ordered

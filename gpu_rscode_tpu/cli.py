@@ -116,7 +116,22 @@ Subcommands: rs stats [--text] [--workload]
             rs doctor [--json]
             (one-shot environment diagnostic: backend/devices, native
             lib, mesh sanity, RS_* knobs, ledger/endpoint reachability,
-            roofline freshness)
+            serve-daemon health, roofline freshness)
+            rs serve [--root DIR] [--port P] [--addr A] [--depth N]
+            [--batch-ms MS] [--max-batch N] [--workers N]
+            [--warm K,N[,W]] [--faults SPEC]
+            (resident multi-tenant encode/decode daemon: POST /encode
+            /decode /scrub with streaming bodies, X-RS-Tenant fairness,
+            429 past RS_SERVE_DEPTH, cross-request batching into the
+            warm plan cache, graceful drain on SIGTERM; docs/SERVE.md)
+            rs loadgen [--url U | --spawn] [--duration S] [--rate R]
+            [--tenants a:3,b:1] [--size-kb N] [--decode-frac F]
+            [--k K] [--n N] [--seed S] [--ab --files N]
+            [--faults SPEC] [--capture PATH] [--json]
+            (open-loop Poisson load harness for rs serve: offered vs
+            achieved throughput, per-tenant latency percentiles, bench
+            capture; --ab times resident-daemon vs CLI-subprocess-per-
+            file on the same encode workload)
             RS_PROFILE=DIR wraps every file operation (scrub/fleet/chaos
             included) in a jax.profiler capture; --profile-dir is the
             per-run alias
@@ -419,6 +434,14 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.doctor import main as _doctor_main
 
         return _doctor_main(argv[1:])
+    if argv and argv[0] == "serve":
+        from .serve.daemon import main as _serve_daemon_main
+
+        return _serve_daemon_main(argv[1:])
+    if argv and argv[0] == "loadgen":
+        from .serve.loadgen import main as _loadgen_main
+
+        return _loadgen_main(argv[1:])
     try:
         # gnu_getopt: flags may follow the fleet-repair positional archives
         # (the reference surface has no positionals, so ordering semantics

@@ -22,6 +22,9 @@ Sections:
 * ``ledger`` — RS_RUNLOG presence, record count, writability.
 * ``metrics_endpoint`` — RS_METRICS_PORT reachability (one local HTTP
   probe of ``/healthz``).
+* ``serve`` — the resident daemon (docs/SERVE.md): configured port and
+  queue/batch knobs, plus a live ``/healthz`` probe of a running
+  daemon (queue depth, draining state).
 * ``roofline`` — per-host calibration from the ledger and its age vs
   ``RS_ROOFLINE_MAX_AGE_S`` (obs/attrib.py).
 
@@ -46,7 +49,7 @@ SCHEMA_VERSION = 1
 # The --json document's stable surface (pinned by tests): these keys are
 # always present, whatever the environment looks like.
 SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "ledger",
-            "metrics_endpoint", "roofline")
+            "metrics_endpoint", "serve", "roofline")
 
 
 def _jax_section() -> dict:
@@ -201,6 +204,55 @@ def _endpoint_section(probe: bool = True) -> dict:
     return out
 
 
+def _serve_section(probe: bool = True) -> dict:
+    """Serve-daemon facts (docs/SERVE.md): the configured port and queue
+    knobs (env-resolved, same precedence the daemon uses) plus one local
+    ``/healthz`` probe of a running daemon when a port is configured."""
+    from ..serve.batcher import DEFAULT_BATCH_MS, DEFAULT_MAX_BATCH
+    from ..serve.daemon import DEFAULT_PORT
+    from ..serve.queue import DEFAULT_DEPTH, DEFAULT_QUANTUM
+    from ..utils.env import float_env, int_env
+
+    port = os.environ.get("RS_SERVE_PORT")
+    out: dict = {
+        "port": port,
+        "default_port": DEFAULT_PORT,
+        "depth": int_env("RS_SERVE_DEPTH", DEFAULT_DEPTH),
+        "quantum": int_env("RS_SERVE_QUANTUM", DEFAULT_QUANTUM),
+        "batch_ms": float_env("RS_SERVE_BATCH_MS", DEFAULT_BATCH_MS),
+        "max_batch": int_env("RS_SERVE_MAX_BATCH", DEFAULT_MAX_BATCH),
+        "workers": int_env("RS_SERVE_WORKERS", 2),
+        "reachable": None,
+        "daemon": None,
+        "error": None,
+    }
+    if not port:
+        out["error"] = "RS_SERVE_PORT unset (no resident daemon configured)"
+        return out
+    if not probe:
+        return out
+    try:
+        import urllib.request
+
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{int(port)}/healthz", timeout=2
+        ) as resp:
+            body = json.loads(resp.read())
+            out["reachable"] = resp.status == 200
+            # The live daemon's own answer (queue depth, draining,
+            # inflight) — the facts a support thread asks for first.
+            out["daemon"] = {
+                key: body.get(key)
+                for key in ("uptime_s", "draining", "queue_depth",
+                            "inflight", "requests_done",
+                            "requests_failed")
+            }
+    except Exception as e:
+        out["reachable"] = False
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _roofline_section(ledger_records: list[dict]) -> dict:
     out: dict = {"cached": False, "age_s": None, "fresh": None,
                  "triad_gbps": None, "gemm_gflops": None,
@@ -245,6 +297,7 @@ def collect(probe_endpoint: bool = True) -> dict:
         "decoder": _decoder_section(),
         "ledger": ledger,
         "metrics_endpoint": _endpoint_section(probe_endpoint),
+        "serve": _serve_section(probe_endpoint),
         "roofline": _roofline_section(ledger_records),
     }
     warnings = []
@@ -278,6 +331,7 @@ def render(report: dict) -> str:
     m = report["mesh"]
     led = report["ledger"]
     ep = report["metrics_endpoint"]
+    sv = report["serve"]
     rl = report["roofline"]
     lines = [
         f"rs doctor @ {report['host']} "
@@ -311,6 +365,17 @@ def render(report: dict) -> str:
            + ("not probed" if ep["reachable"] is None
               else "reachable" if ep["reachable"] else "UNREACHABLE")
            if ep["port"] else "RS_METRICS_PORT unset"),
+        f"[{'--' if sv['reachable'] is None and sv['port'] else mark(sv['reachable'])}] "
+        "serve daemon: "
+        + (f"port {sv['port']} "
+           + ("not probed" if sv["reachable"] is None
+              else (f"reachable (queue {sv['daemon']['queue_depth']}, "
+                    f"{'draining' if sv['daemon']['draining'] else 'live'})"
+                    if sv["reachable"] and sv["daemon"] else "reachable")
+              if sv["reachable"] else "UNREACHABLE")
+           if sv["port"] else "RS_SERVE_PORT unset")
+        + f"; knobs depth={sv['depth']} batch_ms={sv['batch_ms']} "
+          f"max_batch={sv['max_batch']} workers={sv['workers']}",
         f"[{mark(rl['cached'] and rl['fresh'])}] roofline: "
         + (f"{rl['triad_gbps']} GB/s triad / {rl['gemm_gflops']} GFLOP/s "
            f"gemm, age {rl['age_s']}s "

@@ -91,6 +91,14 @@ class _Handler(BaseHTTPRequestHandler):
             pass  # scraper hung up mid-response; nothing to salvage
 
 
+class _MetricsHTTPServer(ThreadingHTTPServer):
+    # Explicit SO_REUSEADDR (HTTPServer's default, pinned here because
+    # the restart paths depend on it): back-to-back CLI ops and the serve
+    # daemon's restart must rebind through TIME_WAIT, not EADDRINUSE.
+    allow_reuse_address = True
+    daemon_threads = True
+
+
 def make_server(port: int, runlog_path: str | None = None,
                 addr: str | None = None) -> ThreadingHTTPServer:
     """Build (bind, don't run) the exposition server.  A per-server
@@ -100,7 +108,7 @@ def make_server(port: int, runlog_path: str | None = None,
                    {"runlog_path": runlog_path})
     addr = addr if addr is not None else os.environ.get(
         "RS_METRICS_ADDR", "0.0.0.0")
-    return ThreadingHTTPServer((addr, port), handler)
+    return _MetricsHTTPServer((addr, port), handler)
 
 
 def start(port: int, runlog_path: str | None = None,
@@ -115,25 +123,68 @@ def start(port: int, runlog_path: str | None = None,
     thread = threading.Thread(
         target=server.serve_forever, name="rs-metrics-server", daemon=True
     )
+    # The handle stop() joins — a shutdown that doesn't join the serving
+    # thread leaves the socket lingering into the next bind.
+    server._rs_thread = thread
     thread.start()
     return server
+
+
+def stop(server: ThreadingHTTPServer | None) -> None:
+    """Shut a :func:`start`-ed server down COMPLETELY: stop serving,
+    close the listening socket, and join the daemon thread, so the port
+    is immediately rebindable (back-to-back in-process CLI ops, the
+    serve daemon's restart path, test teardowns).  Safe on None."""
+    global _ENV_SERVER
+    if server is None:
+        return
+    server.shutdown()
+    server.server_close()
+    thread = getattr(server, "_rs_thread", None)
+    if thread is not None:
+        thread.join(timeout=5)
+    if server is _ENV_SERVER:
+        _ENV_SERVER = None
+
+
+_ENV_SERVER: ThreadingHTTPServer | None = None
+_ENV_LOCK = threading.Lock()
 
 
 def maybe_start_from_env() -> ThreadingHTTPServer | None:
     """Start the endpoint when ``RS_METRICS_PORT`` is set (the hook the
     CLI calls before every file operation); None otherwise or when the
     port cannot bind (warn, don't fail the run — the endpoint is
-    observability)."""
+    observability).
+
+    One server per process: a second call while the first still serves
+    returns the existing server instead of failing the bind — the
+    EADDRINUSE fix for back-to-back in-process CLI ops (tests,
+    embedders) under one exported ``RS_METRICS_PORT``.  :func:`stop`
+    clears the slot so the port can be re-bound deliberately."""
+    global _ENV_SERVER
     port = os.environ.get("RS_METRICS_PORT")
     if not port:
         return None
-    try:
-        return start(int(port))
-    except (OSError, ValueError) as e:
-        import warnings
+    with _ENV_LOCK:
+        if _ENV_SERVER is not None:
+            # Reuse only a LIVE server: one that was shut down behind our
+            # back (server_close leaves fileno() == -1) must not satisfy
+            # the lookup forever.
+            try:
+                if _ENV_SERVER.socket.fileno() >= 0:
+                    return _ENV_SERVER
+            except (OSError, ValueError):
+                pass
+            _ENV_SERVER = None
+        try:
+            _ENV_SERVER = start(int(port))
+            return _ENV_SERVER
+        except (OSError, ValueError) as e:
+            import warnings
 
-        warnings.warn(
-            f"RS_METRICS_PORT={port!r}: endpoint not started: {e}",
-            stacklevel=2,
-        )
-        return None
+            warnings.warn(
+                f"RS_METRICS_PORT={port!r}: endpoint not started: {e}",
+                stacklevel=2,
+            )
+            return None

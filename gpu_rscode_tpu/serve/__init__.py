@@ -1,0 +1,25 @@
+"""``rs serve`` — resident multi-tenant encode/decode daemon.
+
+The ROADMAP's residency item: every CLI op pays process start, plan-cache
+warmup and staging-ring setup per file; at heavy multi-tenant traffic the
+wins come from keeping one process resident and batching concurrent small
+requests through the warm AOT executables (docs/SERVE.md).
+
+Modules:
+
+* :mod:`.queue`   — bounded admission queue: reject past ``RS_SERVE_DEPTH``,
+  per-tenant deficit-round-robin fairness, deadline-aware ordering;
+* :mod:`.batcher` — cross-request batching by (k, n, w, strategy) shape
+  bucket under the ``RS_SERVE_BATCH_MS`` coalescing window;
+* :mod:`.daemon`  — the HTTP front end (`rs serve`): POST /encode /decode
+  /scrub with streaming bodies, graceful drain on SIGTERM;
+* :mod:`.loadgen` — open-loop (Poisson) load harness (`rs loadgen`) with
+  per-tenant mixes, latency percentiles and bench captures.
+
+Import cost: stdlib only at package level; the daemon imports the jax
+stack lazily when it starts serving.
+"""
+
+from __future__ import annotations
+
+__all__ = ["queue", "batcher", "daemon", "loadgen"]

@@ -1,0 +1,820 @@
+"""``rs serve`` — the resident encode/decode daemon (HTTP front end).
+
+One long-lived process in front of the warm plan cache (docs/SERVE.md):
+
+* ``POST /encode?name=N&k=K&n=TOTAL[&w=8|16][&strategy=S][&generator=G]
+  [&checksum=0|1][&keep=1]`` — request body is the file bytes, streamed
+  to a per-tenant spool under the daemon root and encoded into an
+  archive there; JSON response lists the chunk files written.  The spool
+  is unlinked after a successful encode unless ``keep=1`` — the daemon
+  stores the ARCHIVE, so a later /decode is a real reconstruction.
+* ``POST /decode?name=N`` — auto-decode (survivor discovery, CRC
+  verification, degraded-decode ladder — docs/RESILIENCE.md) of the
+  named archive; the response body streams the rebuilt file bytes.
+* ``POST /scrub?name=N[&syndrome=1]`` — read-only health report
+  (``api.scan_file``) as JSON.
+* ``GET /healthz`` ``/metrics`` ``/stats`` — liveness JSON, Prometheus
+  exposition of the live registry, queue/batcher introspection.
+
+Tenancy: ``X-RS-Tenant`` header (or ``?tenant=``) names the tenant —
+its own namespace directory under the root AND its own fairness queue
+(serve/queue.py).  ``X-RS-Deadline-Ms`` bounds how long the request may
+wait+run; expired requests fail with 504 before touching the device.
+
+Request flow: handler threads stream the body, admit into the bounded
+:class:`~.queue.AdmissionQueue` (429 past ``RS_SERVE_DEPTH``, 503 while
+draining), and block on the request future.  One scheduler thread pulls
+fairness-ordered work through the :class:`~.batcher.Batcher` and hands
+each shape-bucketed batch to a small executor pool
+(``RS_SERVE_WORKERS``); batches run as fleets (shared warm executable +
+one write-behind lane), falling back to per-request execution when a
+fleet fails so one poisoned request — injected faults included — cannot
+take its batchmates down or wedge the queue.  Graceful drain (SIGTERM /
+SIGINT): stop admitting, flush the queue, let in-flight fleets commit
+their ordered writes, then close the listener.
+
+Security note: no authentication — bind loopback (the default) or
+front with a real gateway.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import re
+import signal
+import sys
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..obs import metrics as _metrics, runlog as _runlog
+from .batcher import Batcher
+from .queue import AdmissionQueue, Draining, QueueFull, Request
+
+_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,199}$")
+_COPY_CHUNK = 1024 * 1024
+
+DEFAULT_PORT = 9470
+DEFAULT_REQUEST_TIMEOUT_S = 300.0
+DEFAULT_MAX_BODY = 1 << 30
+
+
+def _safe_name(text: str | None, what: str) -> str:
+    """One path component, no traversal: the only way request input ever
+    reaches the filesystem."""
+    if not text or not _NAME_RE.match(text) or ".." in text:
+        raise ValueError(f"bad {what} {text!r}: want [A-Za-z0-9._-]+")
+    return text
+
+
+def _q1(query: dict, key: str, default: str | None = None) -> str | None:
+    vals = query.get(key)
+    return vals[0] if vals else default
+
+
+class _ServeHTTPServer(ThreadingHTTPServer):
+    # Explicit (HTTPServer already defaults this on): restart/drain paths
+    # must rebind through TIME_WAIT without EADDRINUSE.
+    allow_reuse_address = True
+    daemon_threads = True
+    rs_daemon: "ServeDaemon"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "rs-serve/1"
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # loadgen hammers this — stay quiet
+        pass
+
+    @property
+    def daemon(self) -> "ServeDaemon":
+        return self.server.rs_daemon  # type: ignore[attr-defined]
+
+    # -- response helpers ----------------------------------------------------
+
+    def _send_json(self, code: int, payload: dict,
+                   headers: dict | None = None) -> None:
+        body = (json.dumps(payload) + "\n").encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, code: int, msg: str,
+                         headers: dict | None = None, **extra) -> None:
+        # Several error paths answer BEFORE consuming the request body;
+        # under HTTP/1.1 keep-alive the unread bytes would be parsed as
+        # the next request line.  Errors are rare — close the connection
+        # rather than track which paths drained.
+        self.close_connection = True
+        self._send_json(code, {"ok": False, "error": msg, **extra},
+                        headers)
+
+    # -- GET -----------------------------------------------------------------
+
+    def do_GET(self) -> None:  # noqa: N802 (http.server naming)
+        url = urlparse(self.path)
+        try:
+            if url.path == "/healthz":
+                self._send_json(200, self.daemon.health())
+            elif url.path == "/metrics":
+                body = _metrics.REGISTRY.render_text().encode()
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif url.path == "/stats":
+                self._send_json(200, self.daemon.stats())
+            else:
+                self._send_error_json(404, f"no such path {url.path}")
+        except BrokenPipeError:
+            pass
+
+    # -- POST ----------------------------------------------------------------
+
+    def do_POST(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        query = parse_qs(url.query)
+        try:
+            if url.path not in ("/encode", "/decode", "/scrub"):
+                self._send_error_json(404, f"no such path {url.path}")
+                return
+            try:
+                req = self._admit(url.path[1:], query)
+            except ValueError as e:  # bad name/tenant/params/body
+                self._send_error_json(400, str(e))
+                return
+            if req is None:
+                return  # error response already sent
+            self._respond(req)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # defense: a handler bug must answer 500
+            try:
+                self._send_error_json(500, f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    def _read_body_to(self, spool: str) -> int:
+        """Stream the request body to the spool file; returns byte count."""
+        length = self.headers.get("Content-Length")
+        if length is None:
+            raise ValueError("Content-Length required (no chunked bodies)")
+        remaining = int(length)
+        if remaining > self.daemon.max_body:
+            raise ValueError(
+                f"body of {remaining} bytes exceeds RS_SERVE_MAX_BYTES="
+                f"{self.daemon.max_body}")
+        with open(spool, "wb") as fp:
+            while remaining:
+                block = self.rfile.read(min(_COPY_CHUNK, remaining))
+                if not block:
+                    raise ValueError("request body truncated")
+                fp.write(block)
+                remaining -= len(block)
+        return int(length)
+
+    def _admit(self, op: str, query: dict) -> Request | None:
+        daemon = self.daemon
+        tenant = _safe_name(
+            self.headers.get("X-RS-Tenant") or _q1(query, "tenant")
+            or "default", "tenant")
+        name = _safe_name(_q1(query, "name"), "name")
+        spool = daemon.tenant_path(tenant, name)
+        deadline = None
+        dl_ms = self.headers.get("X-RS-Deadline-Ms")
+        if dl_ms is not None:
+            deadline = time.monotonic() + max(0.0, float(dl_ms)) / 1000.0
+
+        if op == "encode":
+            k = int(_q1(query, "k", "0"))
+            n = int(_q1(query, "n", "0"))
+            if k <= 0 or n <= k:
+                self._send_error_json(400, f"need n > k > 0, got k={k} n={n}")
+                return None
+            w = int(_q1(query, "w", "8"))
+            if w not in (8, 16):
+                self._send_error_json(400, f"w must be 8 or 16, got {w}")
+                return None
+            # Per-request temp: concurrent same-name uploads must never
+            # interleave bytes in one file.  The executor promotes it
+            # onto the spool path under the per-name lock.
+            upload = f"{spool}.up.{daemon.next_upload_id()}"
+            nbytes = self._read_body_to(upload)
+            if nbytes == 0:
+                os.unlink(upload)
+                self._send_error_json(400, "refusing to encode empty body")
+                return None
+            req = Request(
+                "encode", tenant, name, spool, k=k, p=n - k, w=w,
+                strategy=_q1(query, "strategy", "auto"),
+                generator=_q1(query, "generator", "vandermonde"),
+                checksums=_q1(query, "checksum", "1") != "0",
+                keep=_q1(query, "keep", "0") == "1",
+                cost=nbytes, deadline=deadline,
+            )
+            req.upload = upload
+        else:
+            # Drain any (bogus) body so the connection stays usable.
+            length = int(self.headers.get("Content-Length") or 0)
+            while length > 0:
+                block = self.rfile.read(min(_COPY_CHUNK, length))
+                if not block:
+                    break
+                length -= len(block)
+            # Shape key + DRR cost from the archive's own metadata: tiny
+            # read, and it 404s garbage names before they queue.
+            try:
+                k, p, w, total = daemon.archive_shape(spool)
+            except FileNotFoundError:
+                self._send_error_json(
+                    404, f"no archive {name!r} for tenant {tenant!r}")
+                return None
+            except (OSError, ValueError) as e:
+                self._send_error_json(400, f"unreadable archive: {e}")
+                return None
+            req = Request(
+                op, tenant, name, spool, k=k, p=p, w=w,
+                strategy=_q1(query, "strategy", "auto"),
+                syndrome=_q1(query, "syndrome", "0") == "1",
+                cost=total, deadline=deadline,
+            )
+
+        try:
+            daemon.queue.submit(req)
+        except QueueFull as e:
+            daemon.discard_upload(req)
+            self._send_error_json(429, str(e), {"Retry-After": "1"})
+            return None
+        except Draining as e:
+            daemon.discard_upload(req)
+            self._send_error_json(503, str(e), {"Retry-After": "5"})
+            return None
+        return req
+
+    def _respond(self, req: Request) -> None:
+        if not req.done.wait(self.daemon.request_timeout_s):
+            self._send_error_json(
+                500, f"request timed out after "
+                f"{self.daemon.request_timeout_s}s in the daemon")
+            return
+        base = {
+            "ok": req.outcome == "ok",
+            "op": req.op, "tenant": req.tenant, "name": req.name,
+            "batch": req.batch_size,
+            "queue_wait_ms": round(req.queue_wait_s * 1e3, 3),
+            "service_ms": round(req.service_s * 1e3, 3),
+        }
+        if req.outcome == "expired":
+            self._send_json(504, {
+                **base, "error": "deadline exceeded before execution"})
+        elif req.outcome != "ok":
+            self._send_json(500, {
+                **base,
+                "error": str(req.error),
+                "error_type": type(req.error).__name__
+                if req.error else None,
+            })
+        elif req.op == "decode":
+            out_path = req.result
+            try:
+                size = os.path.getsize(out_path)
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(size))
+                self.send_header("X-RS-Batch", str(req.batch_size))
+                self.end_headers()
+                with open(out_path, "rb") as fp:
+                    while True:
+                        block = fp.read(_COPY_CHUNK)
+                        if not block:
+                            break
+                        self.wfile.write(block)
+            finally:
+                # The streamed copy is the response; the daemon keeps the
+                # archive, not decode outputs.
+                try:
+                    os.unlink(out_path)
+                except OSError:
+                    pass
+        else:
+            payload = dict(base)
+            if req.op == "encode":
+                payload["bytes"] = req.cost
+                payload["files"] = [
+                    os.path.basename(f) for f in (req.result or [])]
+            else:  # scrub
+                payload["report"] = req.result
+            self._send_json(200, payload)
+
+
+class ServeDaemon:
+    """The resident daemon: queue + batcher + scheduler + HTTP listener.
+
+    Library surface (tests, loadgen --spawn): construct, :meth:`start`,
+    talk HTTP to ``self.port``, then :meth:`close` (drains by default).
+    """
+
+    def __init__(self, root: str, *, port: int = 0, addr: str | None = None,
+                 depth: int | None = None, quantum: int | None = None,
+                 batch_ms: float | None = None, max_batch: int | None = None,
+                 workers: int | None = None,
+                 request_timeout_s: float | None = None,
+                 max_body: int | None = None):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self.addr = addr if addr is not None else os.environ.get(
+            "RS_SERVE_ADDR", "127.0.0.1")
+        self.queue = AdmissionQueue(depth=depth, quantum=quantum)
+        self.batcher = Batcher(self.queue, batch_ms=batch_ms,
+                               max_batch=max_batch)
+        self.workers = max(1, workers if workers is not None else int(
+            os.environ.get("RS_SERVE_WORKERS", "2") or 2))
+        self.request_timeout_s = (
+            float(os.environ.get("RS_SERVE_TIMEOUT_S",
+                                 DEFAULT_REQUEST_TIMEOUT_S))
+            if request_timeout_s is None else request_timeout_s)
+        self.max_body = (
+            int(os.environ.get("RS_SERVE_MAX_BYTES", DEFAULT_MAX_BODY))
+            if max_body is None else max_body)
+        self._server = _ServeHTTPServer((self.addr, port), _Handler)
+        self._server.rs_daemon = self
+        self.port = self._server.server_address[1]
+        self._pool: ThreadPoolExecutor | None = None
+        self._serve_thread: threading.Thread | None = None
+        self._sched_thread: threading.Thread | None = None
+        # One slot per worker: the scheduler may not pop work out of the
+        # admission queue faster than workers consume it — otherwise
+        # requests pile invisibly in the executor's internal queue and
+        # admission control (the 429 depth bound) never fires.
+        self._slots = threading.Semaphore(self.workers)
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        # Per-(tenant, name) mutexes: all executor work on one archive
+        # name serializes (concurrent same-name encodes would interleave
+        # chunk .rs_tmp writes; a decode mid-encode would read a half-
+        # committed archive).  Locks are never dropped — bounded by name
+        # cardinality, two objects each.
+        self._name_locks: dict[tuple, threading.Lock] = {}
+        self._name_locks_guard = threading.Lock()
+        self._upload_ids = itertools.count(1)
+        self._started = time.time()
+        self._closed = False
+        self.requests_done = 0
+        self.requests_failed = 0
+
+    # -- paths / metadata ----------------------------------------------------
+
+    def tenant_path(self, tenant: str, name: str) -> str:
+        d = os.path.join(self.root, tenant)
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, name)
+
+    def next_upload_id(self) -> int:
+        return next(self._upload_ids)
+
+    def _name_lock(self, key: tuple) -> threading.Lock:
+        with self._name_locks_guard:
+            lock = self._name_locks.get(key)
+            if lock is None:
+                lock = self._name_locks[key] = threading.Lock()
+            return lock
+
+    @contextlib.contextmanager
+    def _locked_names(self, reqs: list[Request]):
+        """Hold every distinct (tenant, name) lock of ``reqs`` — acquired
+        in SORTED key order so concurrent fleets can never deadlock."""
+        keys = sorted({(r.tenant, r.name) for r in reqs})
+        with contextlib.ExitStack() as stack:
+            for key in keys:
+                stack.enter_context(self._name_lock(key))
+            yield
+
+    @staticmethod
+    def _promote_upload(req: Request) -> None:
+        """Move the request's consistent upload temp onto the spool path
+        (caller holds the name lock).  Idempotent — a fleet that failed
+        after promotion reruns solo without an upload left to promote."""
+        if req.upload is not None:
+            os.replace(req.upload, req.spool)
+            req.upload = None
+
+    @staticmethod
+    def discard_upload(req: Request) -> None:
+        """Drop an upload temp that will never execute (admission reject,
+        expired deadline)."""
+        if req.upload is not None:
+            try:
+                os.unlink(req.upload)
+            except OSError:
+                pass
+            req.upload = None
+
+    @staticmethod
+    def archive_shape(spool: str) -> tuple[int, int, int, int]:
+        """(k, p, w, total_size) from the archive's .METADATA — the shape
+        bucket and DRR cost of a decode/scrub request."""
+        from ..utils.fileformat import metadata_file_name, read_metadata_ext
+
+        meta = metadata_file_name(spool)
+        total_size, p, k, _mat, w, _crcs = read_metadata_ext(meta)
+        return k, p, w, max(1, int(total_size))
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "ServeDaemon":
+        # A daemon without metrics would serve an empty /metrics forever.
+        _metrics.force_enable()
+        self._pool = ThreadPoolExecutor(
+            max_workers=self.workers, thread_name_prefix="rs-serve-exec")
+        self._sched_thread = threading.Thread(
+            target=self._schedule, name="rs-serve-sched", daemon=True)
+        self._sched_thread.start()
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, name="rs-serve-http",
+            daemon=True)
+        self._serve_thread.start()
+        return self
+
+    def warm(self, k: int, p: int, *, w: int = 8, strategy: str = "auto",
+             generator: str = "vandermonde",
+             file_bytes: int | None = None) -> dict:
+        """Pre-compile the encode executable for a shape bucket so the
+        first real request doesn't pay the compile (api.warm_plan).
+        ``file_bytes`` sizes the bucket like the expected requests will
+        (small-file workloads hit small column buckets)."""
+        from .. import api
+
+        return api.warm_plan(k, p, w=w, strategy=strategy,
+                             generator=generator, file_bytes=file_bytes)
+
+    def drain(self, timeout: float | None = None) -> bool:
+        """Graceful drain: stop admitting, flush the queue, wait for
+        in-flight batches to commit their ordered writes.  Returns True
+        when everything flushed inside ``timeout``."""
+        _metrics.gauge("rs_serve_draining",
+                       "1 while the daemon refuses new work").set(1)
+        self.queue.drain()
+        deadline = (time.monotonic() + timeout) if timeout else None
+        if self._sched_thread is not None:
+            self._sched_thread.join(
+                None if deadline is None
+                else max(0.0, deadline - time.monotonic()))
+            if self._sched_thread.is_alive():
+                return False
+        with self._inflight_cond:
+            while self._inflight:
+                remaining = (None if deadline is None
+                             else deadline - time.monotonic())
+                if remaining is not None and remaining <= 0:
+                    return False
+                self._inflight_cond.wait(remaining)
+        return True
+
+    def close(self, drain: bool = True,
+              timeout: float | None = None) -> None:
+        """Shut down: optional graceful drain, then stop the listener and
+        join every thread (the restart path must be able to rebind)."""
+        if self._closed:
+            return
+        self._closed = True
+        if drain:
+            self.drain(timeout)
+        else:
+            self.queue.drain()
+        if self._pool is not None:
+            self._pool.shutdown(wait=drain)
+        if self._serve_thread is not None:
+            # shutdown() handshakes with a RUNNING serve_forever loop —
+            # on a bound-but-never-started daemon it would block forever.
+            self._server.shutdown()
+        self._server.server_close()
+        if self._serve_thread is not None:
+            self._serve_thread.join(5)
+        if self._sched_thread is not None:
+            self._sched_thread.join(5)
+
+    # -- introspection -------------------------------------------------------
+
+    def health(self) -> dict:
+        return {
+            "ok": True,
+            "role": "rs-serve",
+            "uptime_s": round(time.time() - self._started, 3),
+            "host": os.uname().nodename,
+            "run": _runlog.run_id(),
+            "backend": _runlog.backend_name(),
+            "root": self.root,
+            "draining": self.queue.draining,
+            "queue_depth": self.queue.depth(),
+            "inflight": self._inflight,
+            "requests_done": self.requests_done,
+            "requests_failed": self.requests_failed,
+        }
+
+    def stats(self) -> dict:
+        return {
+            "queue": self.queue.snapshot(),
+            "batcher": self.batcher.snapshot(),
+            "workers": self.workers,
+            "inflight": self._inflight,
+            "requests_done": self.requests_done,
+            "requests_failed": self.requests_failed,
+        }
+
+    # -- scheduling / execution ----------------------------------------------
+
+    def _schedule(self) -> None:
+        while True:
+            batches = self.batcher.next_batches(timeout=0.25)
+            if batches:
+                for group in batches:
+                    with self._inflight_cond:
+                        self._inflight += len(group)
+                    self._slots.acquire()  # blocks until a worker frees
+                    self._pool.submit(self._run_group, group)
+                continue
+            if self.queue.draining and not self.queue.depth():
+                return  # drained dry — scheduler done
+
+    def _finish(self, req: Request, outcome: str, result=None,
+                error: BaseException | None = None) -> None:
+        req.service_s = time.monotonic() - req.arrival - req.queue_wait_s
+        _metrics.counter(
+            "rs_serve_requests_total", "serve requests by outcome",
+        ).labels(op=req.op, tenant=req.tenant, outcome=outcome).inc()
+        _metrics.quantile(
+            "rs_serve_request_wall_seconds",
+            "request wall (admission to completion), streaming quantiles",
+        ).labels(op=req.op).observe(time.monotonic() - req.arrival)
+        _metrics.quantile(
+            "rs_serve_queue_wait_seconds",
+            "time spent waiting for admission-queue dispatch",
+        ).labels(op=req.op).observe(req.queue_wait_s)
+        with self._inflight_cond:  # executor threads race these counters
+            if outcome == "ok":
+                self.requests_done += 1
+            else:
+                self.requests_failed += 1
+        req.finish(outcome, result=result, error=error)
+
+    def _run_group(self, group: list[Request]) -> None:
+        try:
+            _metrics.histogram(
+                "rs_serve_batch_size",
+                "requests coalesced per shape-bucketed batch",
+                buckets=(1, 2, 4, 8, 16, 32, 64),
+            ).observe(len(group))
+            now = time.monotonic()
+            live: list[Request] = []
+            for req in group:
+                if req.expired(now):
+                    self.discard_upload(req)
+                    self._finish(req, "expired", error=TimeoutError(
+                        "deadline exceeded before execution"))
+                else:
+                    req.batch_size = len(group)
+                    live.append(req)
+            if not live:
+                return
+            distinct = len({(r.tenant, r.name) for r in live})
+            if (len(live) > 1 and distinct == len(live)
+                    and live[0].op in ("encode", "decode")):
+                # Duplicate (tenant, name) members force the solo path:
+                # a fleet would encode one spool twice (or collapse two
+                # decodes onto one output); solo runs serialize them
+                # under the per-name lock with per-seq outputs.
+                if self._run_fleet(live):
+                    return
+                # Fleet is fail-fast: one poisoned request aborts the
+                # batch.  Isolation fallback — rerun each request solo so
+                # only the truly failing one reports an error.
+                _metrics.counter(
+                    "rs_serve_batch_fallbacks_total",
+                    "batches degraded to per-request execution",
+                ).inc()
+            for req in live:
+                self._run_solo(req)
+        except BaseException as e:  # scheduler must survive anything
+            for req in group:
+                if not req.done.is_set():
+                    self.discard_upload(req)
+                    self._finish(req, "error", error=e)
+        finally:
+            self._slots.release()
+            with self._inflight_cond:
+                self._inflight -= len(group)
+                self._inflight_cond.notify_all()
+
+    def _run_fleet(self, live: list[Request]) -> bool:
+        """One warm-executable fleet for a same-shape batch; False when it
+        failed and the caller should fall back to solo isolation."""
+        from .. import api
+
+        lead = live[0]
+        try:
+            with self._locked_names(live):
+                if lead.op == "encode":
+                    for r in live:
+                        self._promote_upload(r)
+                    results = api.encode_fleet(
+                        [r.spool for r in live], lead.k, lead.p,
+                        generator=lead.generator, strategy=lead.strategy,
+                        checksums=lead.checksums, w=lead.w,
+                    )
+                    for r in live:
+                        self._finish_encode(r, results[r.spool])
+                else:
+                    outputs = {r.spool: self._decode_out(r)
+                               for r in live}
+                    results = api.decode_fleet(
+                        [r.spool for r in live], outputs,
+                        strategy=lead.strategy,
+                    )
+                    for r in live:
+                        self._finish(r, "ok", result=results[r.spool])
+            return True
+        except Exception:
+            return False
+
+    @staticmethod
+    def _decode_out(req: Request) -> str:
+        # Unique per request: concurrent decodes of one archive must not
+        # race on the output path (seq is admission-unique).
+        return f"{req.spool}.out.{req.seq}"
+
+    def _finish_encode(self, req: Request, files: list[str]) -> None:
+        if not req.keep:
+            try:
+                os.unlink(req.spool)
+            except OSError:
+                pass
+        self._finish(req, "ok", result=files)
+
+    def _run_solo(self, req: Request) -> None:
+        from .. import api
+
+        try:
+            with self._name_lock((req.tenant, req.name)):
+                if req.op == "encode":
+                    self._promote_upload(req)
+                    files = api.encode_file(
+                        req.spool, req.k, req.p,
+                        generator=req.generator,
+                        strategy=req.strategy, checksums=req.checksums,
+                        w=req.w,
+                    )
+                    self._finish_encode(req, files)
+                elif req.op == "decode":
+                    out = api.auto_decode_file(
+                        req.spool, self._decode_out(req),
+                        strategy=req.strategy,
+                    )
+                    self._finish(req, "ok", result=out)
+                else:  # scrub
+                    report = api.scan_file(req.spool,
+                                           syndrome=req.syndrome)
+                    self._finish(req, "ok", result=report)
+        except Exception as e:
+            # Bounded per-request failure (injected faults land here after
+            # the retry plane gave up): 500 for THIS request, queue moves
+            # on — the no-wedge contract.
+            self.discard_upload(req)
+            self._finish(req, "error", error=e)
+
+
+def main(argv=None) -> int:
+    """The ``rs serve`` subcommand."""
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="rs serve",
+        description="Resident multi-tenant encode/decode daemon: "
+        "POST /encode /decode /scrub, admission control, cross-request "
+        "batching, graceful drain on SIGTERM (docs/SERVE.md).",
+    )
+    ap.add_argument("--root", default=None,
+                    help="data root (default $RS_SERVE_ROOT or "
+                    "./rs_serve_root); one namespace dir per tenant")
+    ap.add_argument("--port", type=int, default=None,
+                    help=f"bind port (default $RS_SERVE_PORT or "
+                    f"{DEFAULT_PORT}; 0 = ephemeral)")
+    ap.add_argument("--addr", default=None,
+                    help="bind address (default $RS_SERVE_ADDR or "
+                    "127.0.0.1 — no auth, keep it local)")
+    ap.add_argument("--depth", type=int, default=None,
+                    help="admission depth (default $RS_SERVE_DEPTH or 64)")
+    ap.add_argument("--batch-ms", type=float, default=None,
+                    help="coalescing window (default $RS_SERVE_BATCH_MS "
+                    "or 5)")
+    ap.add_argument("--max-batch", type=int, default=None,
+                    help="batch size cap (default $RS_SERVE_MAX_BATCH "
+                    "or 16)")
+    ap.add_argument("--workers", type=int, default=None,
+                    help="executor threads (default $RS_SERVE_WORKERS "
+                    "or 2)")
+    ap.add_argument("--warm", metavar="K,N[,W[,BYTES]]", action="append",
+                    default=[],
+                    help="pre-compile the encode executable for shape "
+                    "K,N[,W] before listening, bucket-sized for BYTES-"
+                    "sized files when given (repeatable)")
+    ap.add_argument("--faults", metavar="SPEC", default=None,
+                    help="activate the deterministic fault plane for the "
+                    "daemon's lifetime (same grammar as RS_FAULTS; "
+                    "docs/RESILIENCE.md)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    root = args.root or os.environ.get("RS_SERVE_ROOT") or "rs_serve_root"
+    if args.port is None:
+        try:
+            args.port = int(os.environ.get("RS_SERVE_PORT", DEFAULT_PORT))
+        except ValueError:
+            print(f"rs serve: RS_SERVE_PORT="
+                  f"{os.environ['RS_SERVE_PORT']!r} is not a port",
+                  file=sys.stderr)
+            return 2
+
+    fault_ctx = None
+    if args.faults:
+        from ..resilience import faults as _faults
+
+        try:
+            plan = _faults.parse_plan(args.faults, seed=_faults.env_seed())
+        except ValueError as e:
+            print(f"rs serve: bad --faults spec: {e}", file=sys.stderr)
+            return 2
+        fault_ctx = _faults.activate(plan)
+        fault_ctx.__enter__()
+
+    try:
+        daemon = ServeDaemon(
+            root, port=args.port, addr=args.addr, depth=args.depth,
+            batch_ms=args.batch_ms, max_batch=args.max_batch,
+            workers=args.workers,
+        )
+    except OSError as e:
+        print(f"rs serve: cannot bind: {e}", file=sys.stderr)
+        if fault_ctx is not None:
+            fault_ctx.__exit__(None, None, None)
+        return 1
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame):
+        # Handler just flags; the drain (device flushes, ordered commits)
+        # runs on the main thread below.
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    daemon.start()
+    for spec in args.warm:
+        try:
+            parts = [int(x) for x in spec.split(",")]
+        except ValueError:
+            parts = []
+        if len(parts) < 2 or parts[1] <= parts[0]:
+            print(f"rs serve: bad --warm {spec!r} "
+                  "(want K,N[,W[,BYTES]], n > k)", file=sys.stderr)
+            daemon.close(drain=False)
+            if fault_ctx is not None:
+                fault_ctx.__exit__(None, None, None)
+            return 2
+        daemon.warm(parts[0], parts[1] - parts[0],
+                    w=parts[2] if len(parts) > 2 else 8,
+                    file_bytes=parts[3] if len(parts) > 3 else None)
+    print(f"rs serve: listening on http://{daemon.addr}:{daemon.port} "
+          f"(root {daemon.root}, depth {daemon.queue.max_depth}, "
+          f"batch {daemon.batcher.batch_ms}ms x{daemon.batcher.max_batch}, "
+          f"{daemon.workers} workers) — SIGTERM drains", file=sys.stderr)
+    try:
+        stop.wait()
+    finally:
+        print("rs serve: draining...", file=sys.stderr)
+        daemon.close(drain=True)
+        if fault_ctx is not None:
+            fault_ctx.__exit__(None, None, None)
+        print(f"rs serve: drained ({daemon.requests_done} ok, "
+              f"{daemon.requests_failed} failed, "
+              f"{daemon.queue.rejected} rejected)", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,479 @@
+"""``rs loadgen`` — open-loop load harness for the serve daemon.
+
+Serving performance needs a generator that does NOT slow down when the
+server does: arrivals follow a seeded Poisson process (exponential
+inter-arrival gaps at ``--rate`` requests/s), each fired on its own
+thread at its scheduled instant regardless of how many predecessors are
+still in flight — the open-loop discipline that exposes queueing
+collapse, which closed-loop (wait-for-response) drivers mask.  Offered
+vs achieved throughput plus client-side latency percentiles
+(obs/percentile.py estimators — the same math as the Quantile metric
+kind) land in a ``bench_captures/serve_*.jsonl`` capture via the shared
+``capture_header`` identity envelope, so serving joins the BENCH
+trajectory (``rs history`` reads it like any other capture).
+
+Per-tenant mixes: ``--tenants alpha:3,beta:1`` weights arrivals; each
+tenant alternates encode and decode-of-what-it-encoded per ``--mix``.
+
+``--ab`` mode answers the residency question directly: encode the same
+``--files`` small files once through a warm resident daemon and once as
+one CLI subprocess per file (process start + jax import + cold plan
+cache every time — today's deployment model), and records the margin.
+
+``--spawn`` runs an in-process daemon on an ephemeral port (CI smoke,
+A/B resident arm); ``--url`` points at an external one.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+from ..obs import runlog as _runlog
+from ..obs.percentile import QuantileEstimator
+
+_PKG_PARENT = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+
+class _Recorder:
+    """Thread-safe per-(tenant, op) outcome and latency accumulator."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cells: dict[tuple, dict] = {}
+
+    def _cell(self, tenant: str, op: str) -> dict:
+        key = (tenant, op)
+        cell = self.cells.get(key)
+        if cell is None:
+            cell = self.cells[key] = {
+                "sent": 0, "ok": 0, "rejected": 0, "failed": 0,
+                "bytes": 0, "lat": QuantileEstimator(),
+            }
+        return cell
+
+    def record(self, tenant: str, op: str, status: int | None,
+               wall_s: float, nbytes: int) -> None:
+        with self._lock:
+            cell = self._cell(tenant, op)
+            cell["sent"] += 1
+            if status == 200:
+                cell["ok"] += 1
+                cell["bytes"] += nbytes
+                cell["lat"].observe(wall_s)
+            elif status in (429, 503):
+                cell["rejected"] += 1
+            else:
+                cell["failed"] += 1
+
+    def rows(self) -> list[dict]:
+        from ..obs.percentile import state_quantiles
+
+        out = []
+        with self._lock:
+            for (tenant, op), cell in sorted(self.cells.items()):
+                q = state_quantiles(cell["lat"].state())
+                out.append({
+                    "kind": "serve_tenant", "tenant": tenant, "op": op,
+                    "sent": cell["sent"], "ok": cell["ok"],
+                    "rejected": cell["rejected"],
+                    "failed": cell["failed"], "bytes": cell["bytes"],
+                    "latency_s": {
+                        key: round(val, 6) if val is not None else None
+                        for key, val in q.items()
+                    },
+                })
+        return out
+
+    def totals(self) -> dict:
+        with self._lock:
+            agg = {"sent": 0, "ok": 0, "rejected": 0, "failed": 0,
+                   "bytes": 0}
+            for cell in self.cells.values():
+                for key in agg:
+                    agg[key] += cell[key]
+        return agg
+
+
+def _post(url: str, tenant: str, body: bytes | None = None,
+          timeout: float = 120.0) -> tuple[int, bytes]:
+    req = urllib.request.Request(
+        url, data=body if body is not None else b"", method="POST",
+        headers={"X-RS-Tenant": tenant})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        e.close()
+        return e.code, payload
+    except (urllib.error.URLError, OSError, TimeoutError) as e:
+        return None, str(e).encode()  # transport failure — counted failed
+
+
+def _parse_tenants(spec: str) -> list[tuple[str, float]]:
+    out = []
+    for token in spec.split(","):
+        name, _, weight = token.partition(":")
+        out.append((name.strip() or "default",
+                    float(weight) if weight else 1.0))
+    if not out or any(w <= 0 for _, w in out):
+        raise ValueError(f"bad --tenants spec {spec!r}")
+    return out
+
+
+def _schedule(duration_s: float, rate: float, tenants, decode_frac: float,
+              seed: int) -> list[tuple[float, str, str]]:
+    """The full open-loop arrival plan, drawn up front (seeded — the same
+    offered load replays exactly)."""
+    rng = random.Random(seed)
+    names = [t for t, _ in tenants]
+    weights = [w for _, w in tenants]
+    plan = []
+    t = 0.0
+    while True:
+        t += rng.expovariate(rate)
+        if t >= duration_s:
+            return plan
+        tenant = rng.choices(names, weights)[0]
+        op = "decode" if rng.random() < decode_frac else "encode"
+        plan.append((t, tenant, op))
+
+
+def run_open_loop(base_url: str, *, duration_s: float, rate: float,
+                  tenants: list[tuple[str, float]], size_bytes: int,
+                  k: int, p: int, w: int = 8, decode_frac: float = 0.3,
+                  seed: int = 0, quiet: bool = False) -> dict:
+    """Drive the daemon at ``base_url``; returns the summary document."""
+    plan = _schedule(duration_s, rate, tenants, decode_frac, seed)
+    rec = _Recorder()
+    # One shared payload buffer per size (arrival threads must not spend
+    # their schedule slot generating bytes); per-request uniqueness comes
+    # from the name, and decode correctness is the daemon tests' job —
+    # the harness measures.
+    body = random.Random(seed ^ 0x5EED).randbytes(size_bytes)
+    encoded: dict[str, list[str]] = {t: [] for t, _ in tenants}
+    enc_lock = threading.Lock()
+
+    def fire(i: int, tenant: str, op: str) -> None:
+        if op == "decode":
+            with enc_lock:
+                pool = encoded[tenant]
+                name = pool[i % len(pool)] if pool else None
+            if name is None:
+                op = "encode"  # nothing of ours to decode yet
+        if op == "encode":
+            name = f"lg{seed}_{tenant}_{i}.bin"
+            t0 = time.monotonic()
+            status, _ = _post(
+                f"{base_url}/encode?name={name}&k={k}&n={k + p}&w={w}",
+                tenant, body)
+            rec.record(tenant, "encode", status,
+                       time.monotonic() - t0, size_bytes)
+            if status == 200:
+                with enc_lock:
+                    encoded[tenant].append(name)
+        else:
+            t0 = time.monotonic()
+            status, payload = _post(f"{base_url}/decode?name={name}",
+                                    tenant)
+            rec.record(tenant, "decode", status,
+                       time.monotonic() - t0,
+                       len(payload) if status == 200 else 0)
+
+    threads = []
+    t_start = time.monotonic()
+    for i, (t_arr, tenant, op) in enumerate(plan):
+        delay = t_start + t_arr - time.monotonic()
+        if delay > 0:
+            time.sleep(delay)  # open loop: fire on schedule, never on
+            # completion — laggards pile up in flight instead of
+            # throttling the offered load
+        th = threading.Thread(target=fire, args=(i, tenant, op),
+                              daemon=True)
+        th.start()
+        threads.append(th)
+    for th in threads:
+        th.join(timeout=180)
+    wall = time.monotonic() - t_start
+    totals = rec.totals()
+    summary = {
+        "kind": "serve_summary",
+        "duration_s": round(wall, 3),
+        "offered_rps": round(len(plan) / duration_s, 3),
+        "achieved_rps": round(totals["ok"] / wall, 3) if wall else None,
+        "achieved_gbps": round(totals["bytes"] / wall / 1e9, 6)
+        if wall else None,
+        **totals,
+        "config": {"k": k, "n": k + p, "w": w,
+                   "size_bytes": size_bytes, "rate": rate,
+                   "decode_frac": decode_frac, "seed": seed,
+                   "tenants": dict(tenants)},
+    }
+    if not quiet:
+        print(f"loadgen: offered {summary['offered_rps']} rps -> "
+              f"achieved {summary['achieved_rps']} rps "
+              f"({totals['ok']} ok / {totals['rejected']} rejected / "
+              f"{totals['failed']} failed)", file=sys.stderr)
+    return {"summary": summary, "tenants": rec.rows()}
+
+
+# -- A/B: resident daemon vs CLI-subprocess-per-file --------------------------
+
+def _clean_cpu_env() -> dict:
+    """Subprocess env for the per-file CLI arm: CPU backend, no plugin
+    search path (the axon sitecustomize would wedge on a busy tunnel)."""
+    return {
+        "PATH": os.environ.get("PATH", ""),
+        "HOME": os.environ.get("HOME", "/root"),
+        "PYTHONPATH": "",
+        "JAX_PLATFORMS": "cpu",
+    }
+
+
+def run_ab(*, files: int, size_bytes: int, k: int, p: int, w: int = 8,
+           workdir: str, quiet: bool = False) -> list[dict]:
+    """Encode ``files`` small files through (a) one warm resident daemon
+    and (b) one CLI subprocess per file; returns the two arm rows plus
+    the margin row."""
+    from .daemon import ServeDaemon
+
+    rng = random.Random(20260804)
+    paths = []
+    for i in range(files):
+        path = os.path.join(workdir, f"ab_{i}.bin")
+        with open(path, "wb") as fp:
+            fp.write(rng.randbytes(size_bytes))
+        paths.append(path)
+
+    rows = []
+
+    # Arm A — resident: spawn, warm the shape bucket, then time the
+    # whole run of sequential HTTP encodes (spool upload included; the
+    # daemon pays its compile during warm(), like any long-lived server).
+    daemon = ServeDaemon(os.path.join(workdir, "serve_root"), port=0)
+    daemon.start()
+    try:
+        daemon.warm(k, p, w=w, file_bytes=size_bytes)
+        base = f"http://127.0.0.1:{daemon.port}"
+        per_file = []
+        t0 = time.monotonic()
+        for i, path in enumerate(paths):
+            with open(path, "rb") as fp:
+                body = fp.read()
+            t1 = time.monotonic()
+            status, _ = _post(
+                f"{base}/encode?name=ab_{i}.bin&k={k}&n={k + p}&w={w}",
+                "ab", body)
+            per_file.append(time.monotonic() - t1)
+            if status != 200:
+                raise RuntimeError(f"resident encode {i} failed: {status}")
+        wall_a = time.monotonic() - t0
+    finally:
+        daemon.close(drain=True, timeout=60)
+    rows.append(_ab_row("resident", files, size_bytes, wall_a, per_file,
+                        k, p, w))
+
+    # Arm B — today's model: a fresh `rs` CLI process per file (process
+    # start + jax import + cold plan cache, every single file).
+    per_file = []
+    t0 = time.monotonic()
+    for path in paths:
+        t1 = time.monotonic()
+        proc = subprocess.run(
+            [sys.executable, "-m", "gpu_rscode_tpu", "-k", str(k),
+             "-n", str(k + p), "--width", str(w), "--checksum",
+             "--quiet", "-e", path],
+            env=_clean_cpu_env(), cwd=_PKG_PARENT,
+            stdout=subprocess.DEVNULL, stderr=subprocess.PIPE)
+        per_file.append(time.monotonic() - t1)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"subprocess encode failed: {proc.stderr.decode()[-500:]}")
+    wall_b = time.monotonic() - t0
+    rows.append(_ab_row("subprocess", files, size_bytes, wall_b, per_file,
+                        k, p, w))
+
+    margin = wall_b / wall_a if wall_a else None
+    rows.append({
+        "kind": "serve_ab_margin", "files": files,
+        "size_bytes": size_bytes,
+        "resident_wall_s": round(wall_a, 3),
+        "subprocess_wall_s": round(wall_b, 3),
+        "speedup": round(margin, 3) if margin else None,
+    })
+    if not quiet:
+        print(f"loadgen A/B: resident {wall_a:.2f}s vs subprocess "
+              f"{wall_b:.2f}s over {files} files -> "
+              f"{margin:.1f}x", file=sys.stderr)
+    return rows
+
+
+def _ab_row(arm: str, files: int, size_bytes: int, wall: float,
+            per_file: list[float], k: int, p: int, w: int) -> dict:
+    from ..obs.percentile import quantile_of
+
+    return {
+        "kind": "serve_ab", "arm": arm, "files": files,
+        "size_bytes": size_bytes, "wall_s": round(wall, 3),
+        "files_per_s": round(files / wall, 3) if wall else None,
+        "per_file_p50_s": round(quantile_of(per_file, 0.5), 4),
+        "per_file_p99_s": round(quantile_of(per_file, 0.99), 4),
+        "config": {"k": k, "n": k + p, "w": w},
+    }
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def main(argv=None) -> int:
+    """The ``rs loadgen`` subcommand."""
+    import argparse
+    import tempfile
+
+    ap = argparse.ArgumentParser(
+        prog="rs loadgen",
+        description="Open-loop (Poisson) load generator for rs serve: "
+        "per-tenant mixes, offered/achieved throughput, latency "
+        "percentiles, bench_captures capture (docs/SERVE.md).",
+    )
+    ap.add_argument("--url", default=None,
+                    help="daemon base URL (e.g. http://127.0.0.1:9470)")
+    ap.add_argument("--spawn", action="store_true",
+                    help="spawn an in-process daemon on an ephemeral "
+                    "port for the run")
+    ap.add_argument("--root", default=None,
+                    help="--spawn daemon root (default: a temp dir)")
+    ap.add_argument("--duration", type=float, default=15.0,
+                    help="offered-load window in seconds (default 15)")
+    ap.add_argument("--rate", type=float, default=8.0,
+                    help="mean arrival rate, requests/s (default 8)")
+    ap.add_argument("--tenants", default="alpha:3,beta:1",
+                    help="weighted tenant mix, name:weight,... "
+                    "(default alpha:3,beta:1)")
+    ap.add_argument("--size-kb", type=int, default=64,
+                    help="encode payload size (default 64)")
+    ap.add_argument("--decode-frac", type=float, default=0.3,
+                    help="fraction of arrivals that decode (default 0.3)")
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--n", type=int, default=6)
+    ap.add_argument("--w", type=int, default=8, choices=(8, 16))
+    ap.add_argument("--seed", type=int, default=0,
+                    help="arrival-process seed (default 0)")
+    ap.add_argument("--ab", action="store_true",
+                    help="A/B mode instead of open-loop: resident daemon "
+                    "vs CLI subprocess per file on --files encodes")
+    ap.add_argument("--files", type=int, default=100,
+                    help="--ab file count (default 100)")
+    ap.add_argument("--faults", metavar="SPEC", default=None,
+                    help="with --spawn: activate the fault plane in the "
+                    "daemon for the run (bounded-error demonstration)")
+    ap.add_argument("--capture", default=None,
+                    help="capture JSONL path (default bench_captures/"
+                    "serve_<mode>_<backend>_<ts>.jsonl; '-' disables)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the summary document as JSON on stdout")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    if args.n <= args.k or args.k <= 0:
+        print(f"rs loadgen: need n > k > 0 (got k={args.k} n={args.n})",
+              file=sys.stderr)
+        return 2
+    if not args.ab and not args.spawn and not args.url:
+        print("rs loadgen: pass --url or --spawn", file=sys.stderr)
+        return 2
+
+    p = args.n - args.k
+    rows: list[dict] = []
+    fault_ctx = None
+    if args.faults:
+        if not (args.spawn or args.ab):
+            print("rs loadgen: --faults needs --spawn (the plane lives "
+                  "in the daemon process)", file=sys.stderr)
+            return 2
+        from ..resilience import faults as _faults
+
+        try:
+            plan = _faults.parse_plan(args.faults,
+                                      seed=_faults.env_seed())
+        except ValueError as e:
+            print(f"rs loadgen: bad --faults spec: {e}", file=sys.stderr)
+            return 2
+        fault_ctx = _faults.activate(plan)
+        fault_ctx.__enter__()
+
+    tmp = None
+    daemon = None
+    try:
+        with tempfile.TemporaryDirectory(prefix="rs_loadgen_") as tmp:
+            if args.ab:
+                rows = run_ab(
+                    files=args.files, size_bytes=args.size_kb * 1024,
+                    k=args.k, p=p, w=args.w, workdir=tmp,
+                    quiet=args.json)
+                mode = "ab"
+            else:
+                url = args.url
+                if args.spawn:
+                    from .daemon import ServeDaemon
+
+                    daemon = ServeDaemon(
+                        args.root or os.path.join(tmp, "serve_root"),
+                        port=0)
+                    daemon.start()
+                    daemon.warm(args.k, p, w=args.w,
+                                file_bytes=args.size_kb * 1024)
+                    url = f"http://127.0.0.1:{daemon.port}"
+                report = run_open_loop(
+                    url.rstrip("/"), duration_s=args.duration,
+                    rate=args.rate,
+                    tenants=_parse_tenants(args.tenants),
+                    size_bytes=args.size_kb * 1024, k=args.k, p=p,
+                    w=args.w, decode_frac=args.decode_frac,
+                    seed=args.seed, quiet=args.json)
+                if args.faults:
+                    # Self-describing capture: a faulted run's error rows
+                    # must not read as a regression.
+                    report["summary"]["config"]["faults"] = args.faults
+                rows = [report["summary"], *report["tenants"]]
+                if daemon is not None:
+                    rows.append({"kind": "serve_daemon_stats",
+                                 **daemon.stats()})
+                mode = "faulted" if args.faults else "openloop"
+    finally:
+        if daemon is not None:
+            daemon.close(drain=True, timeout=120)
+        if fault_ctx is not None:
+            fault_ctx.__exit__(None, None, None)
+
+    capture = args.capture
+    if capture is None:
+        os.makedirs("bench_captures", exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        capture = os.path.join(
+            "bench_captures",
+            f"serve_{mode}_{_runlog.backend_name() or 'cpu'}_"
+            f"{stamp}.jsonl")
+    if capture != "-":
+        with open(capture, "w") as fp:
+            fp.write(json.dumps(_runlog.capture_header("serve_loadgen"))
+                     + "\n")
+            for row in rows:
+                fp.write(json.dumps(row) + "\n")
+        print(f"rs loadgen: capture -> {capture}", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"rows": rows, "capture": capture}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

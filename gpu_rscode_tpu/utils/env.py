@@ -1,0 +1,25 @@
+"""Tolerant numeric env parsing — the one copy of try/cast/default.
+
+Every knob-reading module used to grow its own private ``_int_env`` /
+``_float_env``; a malformed value must select the DEFAULT, never crash
+an operation mid-flight (the same tolerance ``retry.int_env``
+established).  Import cost: stdlib only.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def int_env(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def float_env(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default

@@ -31,8 +31,16 @@ def _free_port() -> int:
 # init and real collectives, but the pinned jaxlib 0.4.37's gloo TCP
 # transport crashes deterministically on >~30 KB messages
 # ("op.preamble.length <= op.nbytes") — a jaxlib bug, not ours.  Burn-down
-# needs a jaxlib bump; inventory in docs/STATUS.md.
+# needs a jaxlib bump; inventory in docs/STATUS.md.  xfail(strict=False):
+# on the pinned jaxlib tier-1 reports it expected-failing instead of
+# failing; on a bumped jaxlib where gloo works it simply passes.
 @pytest.mark.mesh_known_failure
+@pytest.mark.xfail(
+    strict=False,
+    reason="jaxlib 0.4.37 gloo TCP transport bug: op.preamble.length "
+    "enforce crash on >~30KB messages (docs/STATUS.md); needs a jaxlib "
+    "bump",
+)
 def test_two_process_sharded_gemm(tmp_path):
     port = _free_port()
     procs = []

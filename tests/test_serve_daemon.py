@@ -1,0 +1,498 @@
+"""The resident serve daemon (gpu_rscode_tpu/serve/): admission control,
+DRR fairness, deadline ordering, shape-bucket batching, concurrent
+multi-client round-trips, drain semantics, bounded per-request faults,
+doctor integration and the loadgen harness (docs/SERVE.md).
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api, cli
+from gpu_rscode_tpu.obs import metrics
+from gpu_rscode_tpu.resilience import faults
+from gpu_rscode_tpu.serve.batcher import Batcher
+from gpu_rscode_tpu.serve.daemon import ServeDaemon
+from gpu_rscode_tpu.serve.queue import (
+    AdmissionQueue, Draining, QueueFull, Request,
+)
+
+
+def _req(tenant="t", op="encode", cost=1, deadline=None, k=4, p=2,
+         name="f") -> Request:
+    return Request(op, tenant, name, f"/tmp/{name}", k=k, p=p, cost=cost,
+                   deadline=deadline)
+
+
+# ----- admission queue (pure data structure, no jax) -------------------------
+
+def test_admission_depth_rejects_then_recovers():
+    q = AdmissionQueue(depth=3, quantum=1024)
+    for i in range(3):
+        q.submit(_req(name=f"f{i}"))
+    with pytest.raises(QueueFull):
+        q.submit(_req(name="overflow"))
+    assert q.rejected == 1
+    assert q.pop(timeout=1) is not None
+    q.submit(_req(name="fits-again"))  # depth freed by the pop
+    q.drain()
+    with pytest.raises(Draining):
+        q.submit(_req(name="late"))
+
+
+def test_drr_light_tenant_not_starved_by_greedy_one():
+    q = AdmissionQueue(depth=64, quantum=256 * 1024)
+    for i in range(12):  # greedy tenant: 1 MiB requests, submitted FIRST
+        q.submit(_req(tenant="greedy", cost=1024 * 1024, name=f"g{i}"))
+    for i in range(4):   # light tenant: 64 KiB requests
+        q.submit(_req(tenant="light", cost=64 * 1024, name=f"l{i}"))
+    order = []
+    while q.depth():
+        order.append(q.pop(timeout=1).tenant)
+    assert len(order) == 16
+    # Byte-fairness: every light request clears before the greedy
+    # tenant's backlog does — 4 * 64KiB of light traffic costs one
+    # greedy request's worth of credit, so it must not sit behind 12 MiB.
+    last_light = max(i for i, t in enumerate(order) if t == "light")
+    assert last_light < 8, order
+    assert order.count("greedy") == 12  # and the greedy one still drains
+
+
+def test_deadline_orders_within_tenant():
+    q = AdmissionQueue(depth=16, quantum=1024)
+    now = time.monotonic()
+    q.submit(_req(name="no-deadline"))
+    q.submit(_req(name="far", deadline=now + 60))
+    q.submit(_req(name="near", deadline=now + 1))
+    got = [q.pop(timeout=1).name for _ in range(3)]
+    assert got == ["near", "far", "no-deadline"]
+
+
+def test_expired_helper():
+    assert _req(deadline=time.monotonic() - 1).expired()
+    assert not _req(deadline=time.monotonic() + 60).expired()
+    assert not _req().expired()
+
+
+# ----- batcher ---------------------------------------------------------------
+
+def test_batcher_groups_by_shape_bucket():
+    q = AdmissionQueue(depth=16, quantum=1 << 30)
+    for i in range(3):
+        q.submit(_req(name=f"a{i}", k=4, p=2))
+    for i in range(2):
+        q.submit(_req(name=f"b{i}", k=8, p=4))
+    b = Batcher(q, batch_ms=50, max_batch=16)
+    batches = b.next_batches(timeout=1)
+    sizes = sorted(len(g) for g in batches)
+    assert sizes == [2, 3]
+    for g in batches:  # each group shares ONE plan-cache shape key
+        assert len({r.shape_key() for r in g}) == 1
+    assert b.snapshot()["coalesced"] == 5
+
+
+def test_batcher_zero_window_disables_coalescing():
+    q = AdmissionQueue(depth=16, quantum=1 << 30)
+    for i in range(3):
+        q.submit(_req(name=f"f{i}"))
+    b = Batcher(q, batch_ms=0, max_batch=16)
+    assert [len(g) for g in b.next_batches(timeout=1)] == [1]
+
+
+def test_batcher_respects_max_batch():
+    q = AdmissionQueue(depth=32, quantum=1 << 30)
+    for i in range(10):
+        q.submit(_req(name=f"f{i}"))
+    b = Batcher(q, batch_ms=200, max_batch=4)
+    assert sum(len(g) for g in b.next_batches(timeout=1)) == 4
+
+
+# ----- daemon (HTTP + real encodes) ------------------------------------------
+
+@pytest.fixture
+def daemon(tmp_path):
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=5)
+    d.start()
+    yield d
+    d.close(drain=True, timeout=60)
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def _post(port, path, body=b"", tenant="t1", headers=None, timeout=60):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST",
+        headers={"X-RS-Tenant": tenant, **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read()
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        e.close()
+        return e.code, payload
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, resp.read()
+
+
+def test_concurrent_multi_client_roundtrip(daemon):
+    """N clients encode and decode DISTINCT files through one daemon
+    concurrently; every client gets its own bytes back exactly — the
+    re-entrant-file-ops-under-one-plan-cache acceptance."""
+    rng = np.random.default_rng(7)
+    # Sizes straddle segment boundaries and k-divisibility.
+    sizes = [1000, 65536, 100001, 30000, 7, 250000]
+    payloads = [rng.integers(0, 256, size=s, dtype=np.uint8).tobytes()
+                for s in sizes]
+    results = [None] * len(sizes)
+
+    def client(i):
+        name = f"cli{i}.bin"
+        st, _ = _post(daemon.port, f"/encode?name={name}&k=4&n=6",
+                      payloads[i], tenant=f"ten{i % 2}")
+        if st != 200:
+            results[i] = ("encode", st)
+            return
+        st, body = _post(daemon.port, f"/decode?name={name}",
+                         tenant=f"ten{i % 2}")
+        results[i] = ("ok", st, body)
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(len(sizes))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    for i, res in enumerate(results):
+        assert res is not None and res[0] == "ok", (i, res)
+        assert res[1] == 200
+        assert res[2] == payloads[i], f"client {i}: bytes differ"
+    # Spools were consumed (keep=0 default): the daemon stores archives.
+    for i in range(len(sizes)):
+        assert not os.path.exists(
+            os.path.join(daemon.root, f"ten{i % 2}", f"cli{i}.bin"))
+
+
+def test_concurrent_same_name_encodes_never_interleave(daemon):
+    """Two clients racing an upload to the SAME tenant+name must each
+    encode a CONSISTENT body: the surviving archive decodes to exactly
+    one of the two payloads, never an interleaved hybrid (uploads spool
+    to per-request temps; execution serializes under the name lock)."""
+    a = bytes([1]) * 300_000
+    b = bytes([2]) * 300_000
+    statuses = []
+
+    def client(body):
+        st, _ = _post(daemon.port, "/encode?name=race.bin&k=4&n=6", body)
+        statuses.append(st)
+
+    threads = [threading.Thread(target=client, args=(body,))
+               for body in (a, b)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert statuses == [200, 200], statuses
+    st, got = _post(daemon.port, "/decode?name=race.bin")
+    assert st == 200
+    assert got in (a, b), "decoded bytes are an interleaved hybrid"
+    # No upload temps left behind.
+    leftovers = [f for f in os.listdir(os.path.join(daemon.root, "t1"))
+                 if ".up." in f]
+    assert leftovers == []
+
+
+def test_tenant_namespaces_isolate_same_name(daemon):
+    a, b = os.urandom(5000), os.urandom(9000)
+    assert _post(daemon.port, "/encode?name=same.bin&k=4&n=6", a,
+                 tenant="alpha")[0] == 200
+    assert _post(daemon.port, "/encode?name=same.bin&k=4&n=6", b,
+                 tenant="beta")[0] == 200
+    assert _post(daemon.port, "/decode?name=same.bin",
+                 tenant="alpha")[1] == a
+    assert _post(daemon.port, "/decode?name=same.bin",
+                 tenant="beta")[1] == b
+
+
+def test_scrub_endpoint_reports_health(daemon):
+    assert _post(daemon.port, "/encode?name=s.bin&k=4&n=6",
+                 os.urandom(4000))[0] == 200
+    st, body = _post(daemon.port, "/scrub?name=s.bin")
+    assert st == 200
+    report = json.loads(body)["report"]
+    assert report["decodable"] is True and report["k"] == 4
+
+
+def test_bad_requests_rejected_cleanly(daemon):
+    port = daemon.port
+    assert _post(port, "/encode?name=x.bin&k=4&n=4",
+                 b"zz")[0] == 400          # n <= k
+    assert _post(port, "/encode?name=x.bin&k=4&n=6",
+                 b"")[0] == 400            # empty body
+    st, body = _post(port, "/decode?name=nothere.bin")
+    assert st == 404
+    st, _ = _post(port, "/nope?name=x")
+    assert st == 404
+    # Path traversal names never reach the filesystem.
+    for bad in ("..evil", "%2e%2e%2fevil", "a%2fb"):
+        st, body = _post(port, f"/encode?name={bad}&k=4&n=6", b"zz")
+        assert st == 400, bad
+        assert b"bad name" in body, body
+    assert not os.path.exists(os.path.join(daemon.root, "..", "evil"))
+
+
+def test_healthz_metrics_stats(daemon):
+    assert _post(daemon.port, "/encode?name=h.bin&k=4&n=6",
+                 os.urandom(2048))[0] == 200
+    st, body = _get(daemon.port, "/healthz")
+    health = json.loads(body)
+    assert st == 200 and health["ok"] and health["role"] == "rs-serve"
+    assert health["requests_done"] >= 1
+    st, body = _get(daemon.port, "/metrics")
+    text = body.decode()
+    assert "rs_serve_requests_total" in text
+    assert "rs_serve_request_wall_seconds" in text
+    st, body = _get(daemon.port, "/stats")
+    stats = json.loads(body)
+    assert stats["queue"]["max_depth"] >= 1
+    assert stats["batcher"]["windows"] >= 1
+
+
+def test_batching_coalesces_concurrent_same_shape(tmp_path):
+    """Concurrent same-shape encodes ride one batch (the warm-executable
+    coalescing the daemon exists for)."""
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=500,
+                    max_batch=16, workers=1)
+    d.start()
+    try:
+        d.warm(4, 2, file_bytes=8192)
+        barrier = threading.Barrier(4)
+        out = []
+
+        def client(i):
+            barrier.wait()
+            st, body = _post(d.port, f"/encode?name=b{i}.bin&k=4&n=6",
+                             os.urandom(8192))
+            out.append((st, json.loads(body).get("batch")))
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert all(st == 200 for st, _ in out), out
+        assert max(b for _, b in out) >= 2, out  # some batch formed
+        assert d.batcher.snapshot()["coalesced"] >= 2
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_admission_429_under_backlog_and_drain_commits(tmp_path):
+    """Depth bound holds under a slow worker (429 past RS_SERVE_DEPTH),
+    and drain completes every ADMITTED request before shutdown."""
+    d = ServeDaemon(str(tmp_path / "root"), port=0, depth=2, workers=1,
+                    batch_ms=0)
+    d.start()
+    plan = faults.parse_plan("read:delay@ms=150", seed=1)
+    results = []
+
+    def client(i):
+        st, _ = _post(d.port, f"/encode?name=adm{i}.bin&k=4&n=6",
+                      os.urandom(4096))
+        results.append(st)
+
+    try:
+        with faults.activate(plan):
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(10)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            assert sorted(set(results)) <= [200, 429], results
+            assert results.count(429) >= 1, results  # depth bound fired
+            ok = results.count(200)
+            # Graceful drain: everything admitted commits.
+            assert d.drain(timeout=120)
+            assert d.requests_done == ok
+            assert d.queue.depth() == 0
+        # Post-drain admission refuses with 503.
+        st, _ = _post(d.port, "/encode?name=late.bin&k=4&n=6", b"data")
+        assert st == 503
+        # Every committed archive is complete on disk (6 chunks + meta).
+        committed = [f for f in os.listdir(os.path.join(d.root, "t1"))
+                     if f.endswith(".METADATA")]
+        assert len(committed) == ok
+    finally:
+        d.close(drain=False)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_expired_deadline_fails_without_execution(tmp_path):
+    d = ServeDaemon(str(tmp_path / "root"), port=0)
+    try:
+        req = Request("encode", "t", "x", str(tmp_path / "x"), k=4, p=2,
+                      deadline=time.monotonic() - 0.001)
+        d._run_group([req])
+        assert req.outcome == "expired"
+        assert isinstance(req.error, TimeoutError)
+        assert d.requests_failed == 1
+    finally:
+        d.close(drain=False)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_injected_faults_bounded_errors_no_wedge(tmp_path, monkeypatch):
+    """The acceptance scenario: the chaos fault plane active in the
+    daemon produces bounded per-request outcomes (200 or 500), never a
+    queue wedge, and every success round-trips byte-identically."""
+    monkeypatch.setenv("RS_RETRY_ATTEMPTS", "0")  # let faults surface
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=5)
+    d.start()
+    plan = faults.parse_plan("read:ioerror@p=0.5", seed=42)
+    payloads = {f"flt{i}.bin": os.urandom(4096 + i) for i in range(12)}
+    statuses = {}
+    try:
+        with faults.activate(plan):
+            threads = []
+
+            def client(name, body):
+                st, _ = _post(d.port, f"/encode?name={name}&k=4&n=6",
+                              body)
+                statuses[name] = st
+
+            for name, body in payloads.items():
+                t = threading.Thread(target=client,
+                                     args=(name, body))
+                t.start()
+                threads.append(t)
+            for t in threads:
+                t.join(timeout=120)
+        # Bounded outcomes only — no hangs, no wedge.
+        assert set(statuses.values()) <= {200, 500}, statuses
+        assert statuses and len(statuses) == 12
+        assert any(st == 500 for st in statuses.values()), (
+            "fault plane never fired; raise p or check wiring")
+        # Daemon still healthy and drained.
+        health = json.loads(_get(d.port, "/healthz")[1])
+        assert health["ok"] and health["queue_depth"] == 0
+        # No corrupted output: every success decodes byte-identically
+        # (faults deactivated — we check what was COMMITTED).
+        for name, st in statuses.items():
+            if st == 200:
+                got = _post(d.port, f"/decode?name={name}")
+                assert got[0] == 200 and got[1] == payloads[name], name
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+# ----- warm plan / doctor / loadgen ------------------------------------------
+
+def test_warm_plan_resolves_and_caches():
+    out = api.warm_plan(4, 2, w=8, file_bytes=65536)
+    assert out["k"] == 4 and out["p"] == 2
+    assert out["strategy"] in ("bitplane", "pallas", "table", "cpu")
+    assert out["cols"] >= 1
+    with pytest.raises(ValueError):
+        api.warm_plan(4, 2, w=5)
+
+
+def test_doctor_serve_section(daemon, monkeypatch, capsys):
+    monkeypatch.setenv("RS_SERVE_PORT", str(daemon.port))
+    rc = cli.main(["doctor", "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    serve = report["serve"]
+    assert serve["port"] == str(daemon.port)
+    assert serve["reachable"] is True
+    assert serve["daemon"]["queue_depth"] == 0
+    assert {"depth", "batch_ms", "max_batch", "workers"} <= set(serve)
+    # Unset port: schema stays, probe explains.
+    monkeypatch.delenv("RS_SERVE_PORT")
+    rc = cli.main(["doctor", "--json", "--no-probe"])
+    report = json.loads(capsys.readouterr().out)
+    assert report["serve"]["port"] is None
+    assert report["serve"]["reachable"] is None
+
+
+def test_loadgen_open_loop_capture_schema(tmp_path, capsys):
+    capture = str(tmp_path / "cap.jsonl")
+    rc = cli.main([
+        "loadgen", "--spawn", "--duration", "2", "--rate", "10",
+        "--size-kb", "16", "--tenants", "a:2,b:1", "--seed", "3",
+        "--root", str(tmp_path / "lgroot"), "--capture", capture,
+        "--json",
+    ])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(capture)]
+    assert rows[0]["kind"] == "capture_header"
+    assert rows[0]["tool"] == "serve_loadgen"
+    summary = next(r for r in rows if r["kind"] == "serve_summary")
+    assert summary["failed"] == 0 and summary["rejected"] == 0
+    assert summary["ok"] == summary["sent"] > 0
+    assert summary["offered_rps"] > 0 and summary["achieved_rps"] > 0
+    tenant_rows = [r for r in rows if r["kind"] == "serve_tenant"]
+    assert {r["tenant"] for r in tenant_rows} <= {"a", "b"}
+    for r in tenant_rows:
+        if r["ok"]:
+            assert r["latency_s"]["0.5"] is not None
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+# ----- obs/serve socket lifecycle (satellite) --------------------------------
+
+def test_metrics_endpoint_stop_joins_and_port_rebinds():
+    from gpu_rscode_tpu.obs import serve as obs_serve
+
+    srv = obs_serve.start(0, addr="127.0.0.1")
+    port = srv.server_address[1]
+    thread = srv._rs_thread
+    obs_serve.stop(srv)
+    assert not thread.is_alive()  # the join the restart path needs
+    # Same port, immediately: no EADDRINUSE.
+    srv2 = obs_serve.make_server(port, addr="127.0.0.1")
+    srv2.server_close()
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def test_maybe_start_from_env_reuses_one_server(monkeypatch):
+    from gpu_rscode_tpu.obs import serve as obs_serve
+
+    monkeypatch.setenv("RS_METRICS_PORT", "0")
+    monkeypatch.setenv("RS_METRICS_ADDR", "127.0.0.1")
+    first = obs_serve.maybe_start_from_env()
+    try:
+        assert first is not None
+        # Back-to-back CLI ops in one process: the second call must NOT
+        # warn EADDRINUSE — it reuses the live server.
+        assert obs_serve.maybe_start_from_env() is first
+    finally:
+        obs_serve.stop(first)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+    # stop() cleared the slot: a later call starts fresh.
+    nxt = obs_serve.maybe_start_from_env()
+    try:
+        assert nxt is not None and nxt is not first
+    finally:
+        obs_serve.stop(nxt)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
