@@ -42,11 +42,12 @@ from .utils.fileformat import (
     chunk_crc32,
     chunk_file_name,
     chunk_size_for,
+    chunk_size_for_layout,
     crc32_of,
     metadata_file_name,
     parse_chunk_index,
+    read_archive_meta,
     read_conf,
-    read_metadata_ext,
     rewrite_checksums,
     write_conf,
     write_metadata,
@@ -368,6 +369,29 @@ def _dispatch_span(op: str, off: int, cols: int):
     _obs_attrib.sample_device_memory()
 
 
+def _write_deinterleaved_block(
+    out_fp, off: int, cols: int, blk: np.ndarray, sym: int, total_size: int
+) -> None:
+    """Interleaved-layout output write shared by decode_file and
+    locate_decode_file (docs/UPDATE.md): the chunk-byte window
+    [off, off+cols) of the k rows holds the CONTIGUOUS file range
+    [off*k, (off+cols)*k) — one de-interleave and one write per segment
+    instead of k scattered row writes, clamped to the real file size."""
+    from .update.layout import deinterleave
+
+    k = blk.shape[0]
+    lo = off * k
+    if lo >= total_size:
+        return
+    hi = min(lo + cols * k, total_size)
+    out_fp.seek(lo)
+    out_fp.write(deinterleave(blk, sym)[: hi - lo].tobytes())
+    _obs_metrics.counter(
+        "rs_io_write_bytes_total",
+        "bytes write by the staging-I/O layer",
+    ).labels(call="stream_write").inc(hi - lo)
+
+
 def _segment_spans(chunk_size: int, seg_cols: int) -> list[tuple[int, int]]:
     """(off, cols) spans covering [0, chunk_size) in seg_cols steps."""
     spans = []
@@ -431,6 +455,47 @@ def _open_chunk(
         return _faults.corrupt(path, index, mm, scope=scope)
 
     return _retry.default_policy().call(attempt, op="chunk_open")
+
+
+class _ArchiveCommit:
+    """The single-host encode paths' ``.rs_tmp`` crash-atomicity scaffold
+    (row and interleaved share it): every output — n chunk files AND
+    .METADATA — writes to a temp name and the whole set promotes only
+    after every byte landed, chunks first and .METADATA last (its
+    presence is the marker of a complete encode).  ``discard`` unlinks
+    temps and retracts chunks a failing commit loop already promoted —
+    unless they pre-existed (re-encode over an archive), whose previous
+    bytes are unrecoverable by rename and whose partial new set still
+    scans/repairs via the old .METADATA."""
+
+    def __init__(self, file_name: str, n: int):
+        self.file_name = file_name
+        self.written: list[str] = [
+            chunk_file_name(file_name, i) for i in range(n)
+        ] + [metadata_file_name(file_name)]
+        self.tmps = {name: name + ".rs_tmp" for name in self.written}
+        self._preexisting = {
+            name for name in self.written if os.path.exists(name)
+        }
+        self._committed: list[str] = []
+
+    @property
+    def meta_tmp(self) -> str:
+        return self.tmps[metadata_file_name(self.file_name)]
+
+    def promote(self) -> None:
+        for name in self.written[:-1]:
+            os.replace(self.tmps[name], name)
+            self._committed.append(name)
+        os.replace(self.meta_tmp, metadata_file_name(self.file_name))
+
+    def discard(self) -> None:
+        for tmp in self.tmps.values():
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        for name in self._committed:
+            if name not in self._preexisting and os.path.exists(name):
+                os.unlink(name)
 
 
 def _write_empty_atomic(out_path: str) -> str:
@@ -543,6 +608,7 @@ def encode_file(
     stripe_sharded: bool = False,
     checksums: bool = False,
     w: int = 8,
+    layout: str = "row",
     timer: PhaseTimer | None = None,
     _fleet: FleetPipeline | None = None,
 ) -> list[str]:
@@ -576,6 +642,24 @@ def encode_file(
     total_size = os.path.getsize(file_name)
     if total_size == 0:
         raise ValueError(f"refusing to encode empty file {file_name!r}")
+    if layout not in ("row", "interleaved"):
+        raise ValueError(
+            f"unknown chunk layout {layout!r} (want row or interleaved)"
+        )
+    if layout == "interleaved":
+        # The append-mode layout (docs/UPDATE.md): file symbol s lives in
+        # row s % k, column s // k, so `rs append` only ever touches the
+        # tail column block.  Single-host: the mesh collectives assume
+        # the reference's row-contiguous staging.
+        if mesh is not None:
+            raise ValueError(
+                "interleaved layout encodes single-host; drop --devices"
+            )
+        return _encode_file_interleaved(
+            file_name, codec, total_size, segment_bytes=segment_bytes,
+            pipeline_depth=pipeline_depth, checksums=checksums,
+            timer=timer, _fleet=_fleet,
+        )
     chunk = chunk_size_for(total_size, k, sym)
     seg_cols = _segment_cols(chunk, k, segment_bytes)
 
@@ -593,16 +677,10 @@ def encode_file(
     src = np.memmap(file_name, dtype=np.uint8, mode="r")
 
     # Failure atomicity (same contract decode and repair already keep):
-    # every output — n chunk files AND .METADATA — is written to a
-    # ``.rs_tmp`` name and the whole set is os.replace'd only after every
-    # byte landed.  A mid-encode crash leaves no partial ``_<i>_`` files for
-    # scan_file to misread as a damaged archive.
-    written: list[str] = [
-        chunk_file_name(file_name, i) for i in range(k + p)
-    ] + [metadata_file_name(file_name)]
-    tmps = {name: name + ".rs_tmp" for name in written}
-    preexisting = {name for name in written if os.path.exists(name)}
-    committed: list[str] = []
+    # a mid-encode crash leaves no partial ``_<i>_`` files for scan_file
+    # to misread as a damaged archive (_ArchiveCommit).
+    commit = _ArchiveCommit(file_name, k + p)
+    written, tmps = commit.written, commit.tmps
 
     # Native chunks: straight copies of the k file ranges, tail zero-padded.
     # Copied in bounded slices so a 100 GB chunk never materialises in RAM.
@@ -635,34 +713,19 @@ def encode_file(
         # this file's drains) in batch mode.
         for fp in parity_files:
             fp.close()
-        meta_tmp = tmps[metadata_file_name(file_name)]
         with timer.phase("write metadata (io)"):
-            write_metadata(meta_tmp, total_size, p, k, codec.total_matrix, w=w)
+            write_metadata(
+                commit.meta_tmp, total_size, p, k, codec.total_matrix, w=w
+            )
             if crcs is not None:
-                append_checksums(meta_tmp, crcs)
-        # Commit: chunks first, .METADATA last — its presence is the marker
-        # of a complete encode.
-        for name in written[:-1]:
-            os.replace(tmps[name], name)
-            committed.append(name)
-        os.replace(meta_tmp, metadata_file_name(file_name))
+                append_checksums(commit.meta_tmp, crcs)
+        commit.promote()
 
     def cleanup() -> None:
         for fp in parity_files:
             if not fp.closed:
                 fp.close()
-        for tmp in tmps.values():
-            if os.path.exists(tmp):
-                os.unlink(tmp)
-        # A failure inside the commit loop itself (rename error, interrupt)
-        # may have promoted some chunks already: retract the ones this
-        # encode created so a fresh encode leaves nothing behind.  Names
-        # that pre-existed (re-encode over an archive) are left in place —
-        # their previous bytes are unrecoverable by rename, and a partial
-        # new set still scans/repairs via the old .METADATA.
-        for name in committed:
-            if name not in preexisting and os.path.exists(name):
-                os.unlink(name)
+        commit.discard()
 
     # In a fleet, cleanup is registered up front and runs via the fleet's
     # abort (after its workers joined) — never inline, where it would race
@@ -750,6 +813,127 @@ def _drain_parity(entry, parity_files, timer, crcs=None, k=0) -> None:
         native.scatter_write(parity_files, parity_np, off)
     if new_crcs is not None:
         crcs.update(new_crcs)
+
+
+def _encode_file_interleaved(
+    file_name: str,
+    codec: RSCodec,
+    total_size: int,
+    *,
+    segment_bytes: int,
+    pipeline_depth: int,
+    checksums: bool,
+    timer: PhaseTimer,
+    _fleet: FleetPipeline | None,
+) -> list[str]:
+    """Single-host encode under the interleaved chunk layout
+    (docs/UPDATE.md): each segment is ONE contiguous read of the source
+    file (bytes [off*k, (off+cols)*k)) interleaved into the (k, cols)
+    stripe, natives and parity both scatter-written per column window.
+    Keeps :func:`encode_file`'s contracts: .rs_tmp atomicity, CRC32
+    extension lines, write-behind drain lane, fleet composition."""
+    from . import native
+    from .update.layout import interleave
+
+    k, p, w = codec.native_num, codec.parity_num, codec.w
+    sym = w // 8
+    chunk = chunk_size_for_layout(total_size, k, sym, "interleaved")
+    seg_cols = _segment_cols(chunk, k, segment_bytes)
+    src = np.memmap(file_name, dtype=np.uint8, mode="r")
+
+    commit = _ArchiveCommit(file_name, k + p)
+    written, tmps = commit.written, commit.tmps
+    crcs: dict[int, int] | None = {} if checksums else None
+    files: list = []
+
+    def gather(off: int, cols: int) -> np.ndarray:
+        # One contiguous pread range per segment — the layout's staging
+        # win (row-major staging needs k scattered range reads).  Same
+        # resilience boundary as the row gather: fault hook + retry into
+        # a fresh buffer.
+        def attempt() -> np.ndarray:
+            _faults.on_read(file_name, scope="read")
+            lo = off * k
+            hi = min(lo + cols * k, total_size)
+            buf = np.zeros(cols * k, dtype=np.uint8)
+            if lo < hi:
+                buf[: hi - lo] = src[lo:hi]
+            return interleave(buf, k, sym)
+
+        with timer.phase("stage segment (io)"):
+            return _retry.default_policy().call(attempt, op="encode_stage")
+
+    def drain(tag, payload) -> None:
+        off, cols = tag
+        seg_host, parity = payload
+        with timer.phase("encode compute"):
+            parity_np = np.asarray(parity)
+        if parity_np.dtype != np.uint8:
+            parity_np = np.ascontiguousarray(parity_np).view(np.uint8)
+        new_crcs = (
+            {
+                **{i: crc32_of(seg_host[i], crcs.get(i, 0))
+                   for i in range(k)},
+                **{k + j: crc32_of(parity_np[j], crcs.get(k + j, 0))
+                   for j in range(p)},
+            }
+            if crcs is not None else None
+        )
+        with timer.phase("write natives (io)"):
+            native.scatter_write(files[:k], seg_host, off)
+        with timer.phase("write parity (io)"):
+            native.scatter_write(files[k:], parity_np, off)
+        if new_crcs is not None:
+            crcs.update(new_crcs)
+
+    def finalize() -> None:
+        for fp in files:
+            fp.close()
+        with timer.phase("write metadata (io)"):
+            write_metadata(
+                commit.meta_tmp, total_size, p, k, codec.total_matrix, w=w,
+                layout="interleaved",
+            )
+            if crcs is not None:
+                append_checksums(commit.meta_tmp, crcs)
+        commit.promote()
+
+    def cleanup() -> None:
+        for fp in files:
+            if not fp.closed:
+                fp.close()
+        commit.discard()
+
+    key = _fleet.register(cleanup) if _fleet is not None else None
+    try:
+        with _drain_ctx(_fleet) as dex:
+            for name in written[:-1]:
+                files.append(open(tmps[name], "wb"))
+            with SegmentPrefetcher(
+                _segment_spans(chunk, seg_cols), gather,
+                depth=pipeline_depth,
+            ) as prefetch, AsyncWindow(
+                pipeline_depth, drain, executor=dex
+            ) as window:
+                for (off, cols), seg in prefetch:
+                    with timer.phase("encode dispatch"), _dispatch_span(
+                        "encode", off, cols
+                    ):
+                        staged = codec.stage_segment(
+                            seg, cap=seg_cols // sym, sym=sym,
+                            out_rows=codec.parity_block.shape[0],
+                        )
+                        parity = codec.encode(staged)  # async
+                    window.push((off, cols), (seg, parity))
+        if _fleet is not None:
+            _fleet.commit(key, finalize)
+        else:
+            finalize()
+    except BaseException:
+        if _fleet is None:
+            cleanup()
+        raise
+    return written
 
 
 def _encode_file_multiprocess(
@@ -963,6 +1147,7 @@ def encode_fleet(
     pipeline_depth: int = 2,
     checksums: bool = False,
     w: int = 8,
+    layout: str = "row",
     timer: PhaseTimer | None = None,
 ) -> dict[str, list[str]]:
     """Encode many files back to back through one shared write-behind lane.
@@ -990,7 +1175,8 @@ def encode_fleet(
                 generator=generator, strategy=strategy,
                 segment_bytes=segment_bytes,
                 pipeline_depth=pipeline_depth,
-                checksums=checksums, w=w, timer=timer, _fleet=pipe,
+                checksums=checksums, w=w, layout=layout,
+                timer=timer, _fleet=pipe,
             )
     return results
 
@@ -1090,9 +1276,10 @@ def decode_file(
             verify_checksums=verify_checksums, timer=timer,
         )
     with timer.phase("read metadata (io)"):
-        total_size, p, k, total_mat, w, crcs = read_metadata_ext(
-            metadata_file_name(in_file)
-        )
+        meta = read_archive_meta(metadata_file_name(in_file))
+        total_size, p, k = meta.total_size, meta.parity_num, meta.native_num
+        total_mat, w, crcs = meta.total_mat, meta.w, meta.crcs
+        layout = meta.layout
     _check_gfwidth(w, metadata_file_name(in_file))
     if total_mat is None:
         total_mat = _regenerate_total_matrix(p, k, w)
@@ -1102,7 +1289,7 @@ def decode_file(
             f"GF(2^{w}) — corrupt or foreign .METADATA"
         )
     sym = w // 8
-    chunk = chunk_size_for(total_size, k, sym)
+    chunk = meta.chunk
     names = read_conf(conf_file)
     if len(names) != k:
         raise ValueError(f"conf file lists {len(names)} chunks, need k={k}")
@@ -1282,6 +1469,9 @@ def decode_file(
             "bytes write by the staging-I/O layer",
         ).labels(call="stream_write").inc(hi - lo)
 
+    def write_interleaved(off: int, cols: int, blk: np.ndarray):
+        _write_deinterleaved_block(out_fp, off, cols, blk, sym, total_size)
+
     def _stream(segs) -> None:
         # Bind THIS attempt's derived state into the closures: drains a
         # fleet lane already queued keep the survivor set their recovery
@@ -1300,12 +1490,21 @@ def decode_file(
             if rec_np is not None and rec_np.dtype != np.uint8:
                 rec_np = np.ascontiguousarray(rec_np).view(np.uint8)  # LE
             with timer.phase("write output (io)"):
-                for i in range(k):
-                    if i in native_pos:
-                        src_row = maps_l[native_pos[i]][off : off + cols]
-                        write_row(i, off, cols, src_row)
-                    else:
-                        write_row(i, off, cols, rec_np[rec_row[i]])
+                if layout == "interleaved":
+                    blk = np.empty((k, cols), dtype=np.uint8)
+                    for i in range(k):
+                        if i in native_pos:
+                            blk[i] = maps_l[native_pos[i]][off : off + cols]
+                        else:
+                            blk[i] = rec_np[rec_row[i]][:cols]
+                    write_interleaved(off, cols, blk)
+                else:
+                    for i in range(k):
+                        if i in native_pos:
+                            src_row = maps_l[native_pos[i]][off : off + cols]
+                            write_row(i, off, cols, src_row)
+                        else:
+                            write_row(i, off, cols, rec_np[rec_row[i]])
             committed["n"] = max(committed["n"], off // seg_cols + 1)
 
         from . import native
@@ -1658,10 +1857,18 @@ def _decode_file_multiprocess(
     lead = _is_lead(procs)
 
     with timer.phase("read metadata (io)"):
-        total_size, p, k, total_mat, w, crcs = read_metadata_ext(
-            metadata_file_name(in_file)
+        meta_mp = read_archive_meta(metadata_file_name(in_file))
+        total_size, p, k = (
+            meta_mp.total_size, meta_mp.parity_num, meta_mp.native_num
         )
+        total_mat, w, crcs = meta_mp.total_mat, meta_mp.w, meta_mp.crcs
     _check_gfwidth(w, metadata_file_name(in_file))
+    if meta_mp.layout != "row":
+        raise ValueError(
+            f"{in_file!r} uses the {meta_mp.layout!r} chunk layout; "
+            "multi-process decode handles row-layout archives only — "
+            "decode single-host"
+        )
     sym = w // 8
     if total_mat is None:
         total_mat = _regenerate_total_matrix(p, k, w)
@@ -1864,7 +2071,7 @@ class _ChunkScan:
     chunk indices are healthy, CRC-failing, or missing."""
 
     def __init__(self, in_file, total_size, p, k, total_mat, w, crcs,
-                 chunk, healthy, bad):
+                 chunk, healthy, bad, layout="row", generation=0):
         self.in_file = in_file
         self.total_size = total_size
         self.p = p
@@ -1875,6 +2082,8 @@ class _ChunkScan:
         self.chunk = chunk
         self.healthy = healthy          # indices with full-size, CRC-clean files
         self.bad = bad                  # {index: path} damaged: truncated or CRC-fail
+        self.layout = layout            # chunk layout (docs/UPDATE.md)
+        self.generation = generation    # update/append commit counter
         self.missing = sorted(
             set(range(k + p)) - set(healthy) - set(bad)
         )
@@ -1893,6 +2102,7 @@ class _ChunkScan:
             self.w, self.crcs, self.chunk,
             [i for i in self.healthy if i not in bad],
             {**self.bad, **bad},
+            layout=self.layout, generation=self.generation,
         )
 
 
@@ -1906,9 +2116,11 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
     auto-decode discovery) all feed the same series.
     """
     with _obs_tracing.span("scan_chunks", lane="scrub", file=in_file):
-        meta = metadata_file_name(in_file)
-        total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
-        _check_gfwidth(w, meta)
+        meta_path = metadata_file_name(in_file)
+        meta = read_archive_meta(meta_path)
+        total_size, p, k = meta.total_size, meta.parity_num, meta.native_num
+        total_mat, w, crcs = meta.total_mat, meta.w, meta.crcs
+        _check_gfwidth(w, meta_path)
         if total_mat is None:
             total_mat = _regenerate_total_matrix(p, k, w)
         if int(total_mat.max(initial=0)) >= (1 << w):
@@ -1916,7 +2128,11 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
                 f"metadata matrix entry {int(total_mat.max())} out of range "
                 f"for GF(2^{w}) — corrupt or foreign .METADATA"
             )
-        chunk = chunk_size_for(total_size, k, w // 8)
+        # Layout-aware chunk length: interleaved archives (the append-mode
+        # extension, docs/UPDATE.md) size chunks by columns, not rows.
+        # Everything below — size check, CRC over the whole chunk file,
+        # health verdicts — is layout-agnostic given the right length.
+        chunk = meta.chunk
         chunk_states = _obs_metrics.counter(
             "rs_scrub_chunks_total", "chunk verdicts from archive scans"
         )
@@ -1960,7 +2176,8 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
         ).labels(outcome="damaged" if bad or len(healthy) < k + p
                  else "clean").inc()
         return _ChunkScan(
-            in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy, bad
+            in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy,
+            bad, layout=meta.layout, generation=meta.generation,
         )
 
 
@@ -2544,10 +2761,7 @@ def locate_decode_file(
                 if fixes:
                     segv = seg.view(np.uint16) if sym == 2 else seg
                     correct_segment(segv, fixes, row_of)
-                with timer.phase("write output (io)"):
-                    for i in range(k):
-                        if i in row_of:
-                            write_row(i, off, cols, seg[row_of[i]])
+                rec_np = None
                 if dec_missing is not None:
                     with timer.phase("locate dispatch"), _dispatch_span(
                         "decode", off, cols
@@ -2562,7 +2776,21 @@ def locate_decode_file(
                         rec_np = np.asarray(rec)
                     if rec_np.dtype != np.uint8:
                         rec_np = np.ascontiguousarray(rec_np).view(np.uint8)
-                    with timer.phase("write output (io)"):
+                with timer.phase("write output (io)"):
+                    if scan.layout == "interleaved":
+                        blk = np.empty((k, cols), dtype=np.uint8)
+                        for i in range(k):
+                            blk[i] = (
+                                seg[row_of[i], :cols] if i in row_of
+                                else rec_np[rec_row[i]][:cols]
+                            )
+                        _write_deinterleaved_block(
+                            out_fp, off, cols, blk, sym, scan.total_size
+                        )
+                    else:
+                        for i in range(k):
+                            if i in row_of:
+                                write_row(i, off, cols, seg[row_of[i]])
                         for i in missing:
                             write_row(i, off, cols, rec_np[rec_row[i]])
         out_fp.truncate(scan.total_size)
@@ -2848,7 +3076,11 @@ def _repair_file_multiprocess(
     # the verdict as a (k+p,) array: 0 = missing, 1 = healthy, 2 = damaged.
     with timer.phase("scan chunks (io)"):
         meta = metadata_file_name(in_file)
-        total_size, p, k, total_mat, w, crcs = read_metadata_ext(meta)
+        meta_obj = read_archive_meta(meta)
+        total_size, p, k = (
+            meta_obj.total_size, meta_obj.parity_num, meta_obj.native_num
+        )
+        total_mat, w, crcs = meta_obj.total_mat, meta_obj.w, meta_obj.crcs
         _check_gfwidth(w, meta)
         sym = w // 8
         if total_mat is None:
@@ -2882,9 +3114,12 @@ def _repair_file_multiprocess(
         int(i): chunk_file_name(in_file, int(i))
         for i in np.flatnonzero(state == 2)
     }
-    chunk = chunk_size_for(total_size, k, sym)
+    # Repair is chunk-layout-agnostic (column-wise linear algebra over
+    # whole chunk files) — only the expected chunk LENGTH differs.
+    chunk = chunk_size_for_layout(total_size, k, sym, meta_obj.layout)
     scan_view = _ChunkScan(
-        in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy, bad
+        in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy,
+        bad, layout=meta_obj.layout, generation=meta_obj.generation,
     )
     targets = scan_view.unhealthy
     if not targets:
@@ -3166,6 +3401,90 @@ def repair_fleet(
     return results
 
 
+# -- partial-stripe updates and append-mode encoding (update/) ---------------
+#
+# RS linearity: parity' = parity ⊕ E·Δ, so a byte-range edit moves only
+# its touched segment columns, and an append (interleaved layout) only
+# the tail column block — docs/UPDATE.md.  Both ops are crash-atomic:
+# undo journal before any in-place byte, atomic generation-bumping
+# .METADATA rewrite as the commit point, rollback on failure or at the
+# next open (recover_archive).
+
+
+@_observed_file_op("update")
+def update_file(
+    file_name: str,
+    at: int,
+    data=None,
+    *,
+    src: str | None = None,
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    timer: PhaseTimer | None = None,
+) -> dict:
+    """Overwrite bytes [at, at+len) of the archived file in place —
+    ``rs update ARCHIVE --at OFF --in DELTA``.
+
+    Only the affected segment columns are read and rewritten: Δ = new ⊕
+    old per touched native column, ``E·Δ`` dispatched as a plan-cached
+    GF-GEMM (op="update" — it reuses the warm encode executable), parity
+    XOR-patched through an ordered pwrite lane, per-chunk CRC lines fixed
+    by seekable crc32-combine (no full-chunk re-hash), and the metadata
+    committed atomically with a generation bump.  Pass the new bytes as
+    ``data`` or a file path as ``src``.  Returns the op summary dict
+    (bytes, segments, chunks_touched, generation).  Works on both chunk
+    layouts; requires the touched chunks healthy (repair first
+    otherwise).
+    """
+    from .update import apply_update
+
+    return apply_update(
+        file_name, at, data, src=src, strategy=strategy,
+        segment_bytes=segment_bytes, timer=timer,
+    )
+
+
+@_observed_file_op("append")
+def append_file(
+    file_name: str,
+    data=None,
+    *,
+    src: str | None = None,
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    timer: PhaseTimer | None = None,
+) -> dict:
+    """Grow the archived file by the payload bytes — ``rs append ARCHIVE
+    --in DATA``.
+
+    Interleaved-layout archives (``rs -e ... --layout interleaved``)
+    extend every chunk by just the tail column block: cold columns are
+    never read or written, and only the tail segment's parity is
+    regenerated.  Row-layout (reference) archives accept appends bounded
+    by their tail-padding slack (a larger chunk size would re-stripe the
+    whole file).  Torn appends are detected and rolled back at the next
+    open (undo journal + metadata generation).  Returns the op summary
+    dict with the new ``total_size``.
+    """
+    from .update import apply_append
+
+    return apply_append(
+        file_name, data, src=src, strategy=strategy,
+        segment_bytes=segment_bytes, timer=timer,
+    )
+
+
+def recover_archive(file_name: str) -> str:
+    """Resolve a pending update/append journal next to ``file_name``
+    (run automatically at the top of every update/append; exposed for
+    ``rs update --recover`` and post-crash decode hygiene).  Returns
+    ``none`` / ``stale_discarded`` / ``invalid_discarded`` /
+    ``rolled_back``."""
+    from .update import recover
+
+    return recover(file_name)
+
+
 @_observed_file_op("scan")
 def scan_file(
     in_file: str,
@@ -3236,11 +3555,19 @@ def scan_file(
     _obs_metrics.counter(
         "rs_scrub_verdicts_total", "scan_file decodability verdicts"
     ).labels(decodable=str(ok)).inc()
+    from .update.journal import journal_path
+
     report = {
         "k": scan.k,
         "p": scan.p,
         "w": scan.w,
         "checksummed": bool(scan.crcs),
+        "layout": scan.layout,            # chunk layout (docs/UPDATE.md)
+        "generation": scan.generation,    # update/append commit counter
+        # A pending journal means the last update/append tore mid-patch:
+        # recover_archive (or the next update/append) rolls it back.
+        # Scrub REPORTS it — a read-only scan must not mutate the archive.
+        "pending_journal": os.path.exists(journal_path(in_file)),
         "healthy": scan.healthy,
         "corrupt": sorted(scan.bad),  # present but truncated or CRC-failing
         "missing": scan.missing,      # absent files

@@ -54,6 +54,10 @@ Extensions: [--generator vandermonde|cauchy]
             [--no-verify] (decode: skip checksum verification)
             [--width 8|16] (encode: GF symbol width; 16 = wide-symbol
             extension recorded in .METADATA, decode auto-detects)
+            [--layout row|interleaved] (encode: chunk layout; interleaved
+            = append-mode extension — file symbol s lives in row s%k,
+            so rs append only touches the tail column block; recorded
+            in .METADATA, decode auto-detects; docs/UPDATE.md)
             [--auto] (decode without -c: discover healthy chunks, skip
             corrupt ones via CRC32, pick a decodable subset.  Extra
             positional archives after the flags decode a whole batch
@@ -88,7 +92,19 @@ Resilience (docs/RESILIENCE.md):
             boundaries, e.g. "read:ioerror@p=0.02;write:torn@after=1MiB";
             equivalent to RS_FAULTS=SPEC, seeded by RS_FAULTS_SEED;
             RS_RETRY_* env knobs tune the retry/backoff policy)
-Subcommands: rs stats [--text] [--workload]
+Subcommands: rs update ARCHIVE --at OFF --in DELTA [--recover] [--json]
+            (delta-parity partial-stripe update: overwrite a byte range
+            of the archived file in place — parity' = parity XOR E*delta,
+            only the touched segment columns move; crash-atomic via the
+            undo journal + metadata generation; per-chunk CRCs fixed by
+            seekable crc32-combine.  --recover resolves a torn op's
+            journal and exits; docs/UPDATE.md)
+            rs append ARCHIVE --in DATA [--json]
+            (append-mode encoding: grow the archive without touching
+            cold segments — unbounded on interleaved-layout archives,
+            slack-bounded on row-layout ones; torn appends roll back at
+            the next open)
+            rs stats [--text] [--workload]
             (dump the unified observability snapshot of this process;
             --text = Prometheus exposition, --workload = run a synthetic
             multi-tail encode first)
@@ -126,7 +142,7 @@ Subcommands: rs stats [--text] [--workload]
             warm plan cache, graceful drain on SIGTERM; docs/SERVE.md)
             rs loadgen [--url U | --spawn] [--duration S] [--rate R]
             [--tenants a:3,b:1] [--size-kb N] [--decode-frac F]
-            [--k K] [--n N] [--seed S] [--ab --files N]
+            [--update-frac F] [--k K] [--n N] [--seed S] [--ab --files N]
             [--faults SPEC] [--capture PATH] [--json]
             (open-loop Poisson load harness for rs serve: offered vs
             achieved throughput, per-tenant latency percentiles, bench
@@ -404,6 +420,91 @@ def _serve_main(argv: list[str]) -> int:
     return 0
 
 
+def _update_main(argv: list[str], op: str) -> int:
+    """The ``rs update`` / ``rs append`` subcommands (docs/UPDATE.md):
+    delta-parity partial-stripe updates and append-mode encoding —
+    parity' = parity ⊕ E·Δ, only the touched segment columns move."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser(
+        prog=f"rs {op}",
+        description=(
+            "Patch a byte range of an encoded archive in place: only the "
+            "touched segment columns are read, the parity delta E*delta "
+            "is XOR-patched, and per-chunk CRCs are fixed incrementally "
+            "(crash-atomic via the undo journal)."
+            if op == "update" else
+            "Grow an encoded archive: interleaved-layout archives extend "
+            "every chunk by just the tail column block (cold columns "
+            "untouched); row-layout archives accept appends bounded by "
+            "their tail-padding slack.  Torn appends roll back at the "
+            "next open."
+        ),
+    )
+    ap.add_argument("archive", help="the encoded file (chunk files and "
+                    ".METADATA live next to it)")
+    if op == "update":
+        ap.add_argument("--at", type=int, default=None,
+                        help="byte offset of the edit in the original file")
+        ap.add_argument("--recover", action="store_true",
+                        help="only resolve a pending torn update/append "
+                        "journal (rollback), then exit")
+    ap.add_argument("--in", dest="in_path", metavar="FILE", default=None,
+                    help=("the replacement bytes" if op == "update"
+                          else "the bytes to append"))
+    ap.add_argument("--strategy", default="auto",
+                    choices=("auto", "bitplane", "table", "pallas", "cpu"))
+    ap.add_argument("--segment-bytes", type=int, default=None,
+                    help="column block sizing (default 64 MiB of natives)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the op summary as one JSON line")
+    ap.add_argument("--quiet", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    from . import api
+
+    try:
+        if op == "update" and args.recover:
+            verdict = api.recover_archive(args.archive)
+            print(json.dumps({"archive": args.archive,
+                              "recovered": verdict}))
+            return 0
+        if args.in_path is None:
+            print(f"rs {op}: --in FILE is required", file=sys.stderr)
+            return 2
+        if op == "update" and args.at is None:
+            print("rs update: --at OFFSET is required", file=sys.stderr)
+            return 2
+        kwargs = dict(src=args.in_path, strategy=args.strategy)
+        if args.segment_bytes:
+            kwargs["segment_bytes"] = args.segment_bytes
+        timer = PhaseTimer(enabled=not args.quiet)
+        kwargs["timer"] = timer
+        if op == "update":
+            summary = api.update_file(args.archive, args.at, **kwargs)
+        else:
+            summary = api.append_file(args.archive, **kwargs)
+    except (ValueError, FileNotFoundError, OSError) as e:
+        print(f"rs {op}: error: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(summary))
+    elif not args.quiet:
+        print(f"== {op} {args.archive} ==")
+        print(
+            f"{summary['bytes']} payload bytes -> {summary['segments']} "
+            f"segment block(s), chunks {summary['chunks_touched']}, "
+            f"generation {summary['generation']}, "
+            f"total {summary['total_size']}"
+        )
+        print(timer.summary(data_bytes=summary["bytes"]))
+    return 0
+
+
 def _fail(msg: str) -> "int":
     print(msg, file=sys.stderr)
     print(_USAGE, file=sys.stderr)
@@ -442,6 +543,8 @@ def main(argv: list[str] | None = None) -> int:
         from .serve.loadgen import main as _loadgen_main
 
         return _loadgen_main(argv[1:])
+    if argv and argv[0] in ("update", "append"):
+        return _update_main(argv[1:], argv[0])
     try:
         # gnu_getopt: flags may follow the fleet-repair positional archives
         # (the reference surface has no positionals, so ordering semantics
@@ -460,6 +563,7 @@ def main(argv: list[str] | None = None) -> int:
                 "checksum",
                 "no-verify",
                 "width=",
+                "layout=",
                 "auto",
                 "locate",
                 "repair",
@@ -496,6 +600,7 @@ def main(argv: list[str] | None = None) -> int:
     checksum = False
     no_verify = False
     width = 8
+    layout = "row"
     auto = False
     locate = False
     repair = False
@@ -554,6 +659,8 @@ def main(argv: list[str] | None = None) -> int:
             no_verify = True
         elif f == "--width":
             width = int(val)
+        elif f == "--layout":
+            layout = val
         elif f == "--auto":
             auto = True
         elif f == "--locate":
@@ -618,6 +725,17 @@ def main(argv: list[str] | None = None) -> int:
         return _fail("rs: --width is encode-only (decode reads it from .METADATA)")
     if width not in (8, 16):
         return _fail(f"rs: --width must be 8 or 16, got {width}")
+    if layout != "row":
+        if op != "encode":
+            return _fail(
+                "rs: --layout is encode-only (decode reads it from .METADATA)"
+            )
+        if layout != "interleaved":
+            return _fail(
+                f"rs: --layout must be row or interleaved, got {layout}"
+            )
+        if n_devices:
+            return _fail("rs: --layout interleaved is single-host")
     if auto and op != "decode":
         return _fail("rs: --auto is decode-only")
     if auto and conf_file:
@@ -782,6 +900,7 @@ def main(argv: list[str] | None = None) -> int:
                     generator=generator,
                     checksums=checksum,
                     w=width,
+                    layout=layout,
                     timer=timer,
                     **kwargs,
                 )
@@ -794,6 +913,7 @@ def main(argv: list[str] | None = None) -> int:
                     generator=generator,
                     checksums=checksum,
                     w=width,
+                    layout=layout,
                     timer=timer,
                     **kwargs,
                 )

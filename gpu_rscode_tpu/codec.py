@@ -186,6 +186,20 @@ class RSCodec:
         self._count_segment("decode", chunks)
         return self._matmul(decode_mat, chunks)
 
+    def update(self, parity_mat, delta):
+        """(p, k) parity coefficient block x (k, m) native-symbol delta
+        -> (p, m) parity delta (``parity' = parity ⊕ E·Δ``).
+
+        The partial-stripe update kernel (update/engine.py): RS linearity
+        makes the parity patch a GEMM over just the TOUCHED columns.
+        Same plan-cached/pallas-guarded ``_matmul`` as encode — identical
+        ``A`` shape means an update rides the very executable the encode
+        path (or ``warm_plan``) already compiled — under its own ``op``
+        label so dispatch counts and payload bytes attribute separately
+        (docs/PLAN.md)."""
+        self._count_segment("update", delta)
+        return self._matmul(parity_mat, delta)
+
     def syndrome(self, check_mat, chunks):
         """(r, s) parity-check block x (s, m) stacked chunk rows -> (r, m)
         syndromes (zero columns == consistent codeword columns).

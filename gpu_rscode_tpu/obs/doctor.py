@@ -19,6 +19,8 @@ Sections:
   carried mesh-failure set's signature), forced-host-device flags.
 * ``env`` — every ``RS_*`` knob currently set (the knobs are read per
   call across the codebase, so this is the live configuration).
+* ``update`` — delta-update/append capability (docs/UPDATE.md):
+  supported layouts, crash-safety machinery, CRC fix-up mode.
 * ``ledger`` — RS_RUNLOG presence, record count, writability.
 * ``metrics_endpoint`` — RS_METRICS_PORT reachability (one local HTTP
   probe of ``/healthz``).
@@ -48,8 +50,8 @@ SCHEMA_VERSION = 1
 
 # The --json document's stable surface (pinned by tests): these keys are
 # always present, whatever the environment looks like.
-SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "ledger",
-            "metrics_endpoint", "serve", "roofline")
+SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "update",
+            "ledger", "metrics_endpoint", "serve", "roofline")
 
 
 def _jax_section() -> dict:
@@ -141,6 +143,33 @@ def _decoder_section() -> dict:
             "plan-cached GF-GEMM (codec.syndrome)"
             if hasattr(RSCodec, "syndrome") else None
         )
+    except Exception as e:  # pragma: no cover - import-degraded env
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _update_section() -> dict:
+    """Update/append capability matrix (schema-stable): whether this
+    build can mutate archives in place (docs/UPDATE.md) and with which
+    layouts/safety machinery."""
+    out: dict = {
+        "delta_update": False,
+        "append": False,
+        "layouts": [],
+        "crash_safety": None,
+        "crc_fixup": None,
+        "error": None,
+    }
+    try:
+        from ..update import apply_append, apply_update  # noqa: F401
+
+        out["delta_update"] = True
+        out["append"] = True
+        out["layouts"] = ["row", "interleaved"]
+        out["crash_safety"] = (
+            "undo journal + atomic generation-bumped .METADATA rewrite"
+        )
+        out["crc_fixup"] = "seekable crc32-combine (no full-chunk re-hash)"
     except Exception as e:  # pragma: no cover - import-degraded env
         out["error"] = f"{type(e).__name__}: {e}"
     return out
@@ -295,6 +324,7 @@ def collect(probe_endpoint: bool = True) -> dict:
             if k.startswith("RS_")
         },
         "decoder": _decoder_section(),
+        "update": _update_section(),
         "ledger": ledger,
         "metrics_endpoint": _endpoint_section(probe_endpoint),
         "serve": _serve_section(probe_endpoint),
@@ -354,6 +384,14 @@ def render(report: dict) -> str:
         + ("+locate" if report["decoder"]["locate"] else " ONLY")
         + f", w {report['decoder']['supported_w']}, syndrome kernel "
         + (report["decoder"]["syndrome_kernel"] or "unavailable"),
+        f"[{mark(report['update']['delta_update'])}] update: "
+        + (
+            f"delta update + append, layouts "
+            f"{report['update']['layouts']}, "
+            f"{report['update']['crash_safety']}"
+            if report["update"]["delta_update"]
+            else f"unavailable ({report['update']['error']})"
+        ),
         f"[{mark(led['writable'])}] ledger: "
         + (f"{led['path']} ({led['records']} records)"
            if led["path"] else "RS_RUNLOG unset"),

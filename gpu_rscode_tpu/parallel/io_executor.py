@@ -232,6 +232,30 @@ class DrainExecutor:
         self._q.put((fn, nbytes))
         self._report_depth()
 
+    def submit_pwrite(self, fileno: int, data: bytes, offset: int) -> None:
+        """Queue one offset-addressed ``os.pwrite`` drain — the
+        random-access patch lane of the update/append subsystem
+        (update/engine.py): an ``ordered=True`` executor commits patches
+        strictly in submit order (the per-chunk ascending-offset
+        invariant its incremental CRC accounting depends on), each drain
+        crosses the fault plane's write boundary like every other lane,
+        and a retried drain re-pwrites the same bytes at the same offset
+        (idempotent by construction)."""
+        nbytes = len(data)
+
+        def task() -> None:
+            done = os.pwrite(fileno, data, offset)
+            if done != nbytes:
+                raise OSError(
+                    f"short pwrite ({done} of {nbytes} bytes at {offset})"
+                )
+            _metrics.counter(
+                "rs_io_write_bytes_total",
+                "bytes write by the staging-I/O layer",
+            ).labels(call="patch_pwrite").inc(nbytes)
+
+        self.submit(task, nbytes=nbytes)
+
     def flush(self) -> None:
         """Barrier: block until every submitted drain ran (or was discarded
         after an error), then re-raise the first worker exception."""

@@ -42,6 +42,14 @@ Dispatch strategy per plan:
 * mesh plans — counted and fingerprinted, but the callable is the
   existing jitted ``sharded_gf_matmul`` (XLA's jit cache pins the
   executable; donation is skipped — sharded inputs may be caller-held).
+* update-op plans (``codec.update``, docs/UPDATE.md) — the delta-parity
+  GEMM ``E·Δ`` dispatches with the SAME (p, k) coefficient shape as
+  encode, so its plan key aliases the encode bucket class on purpose: a
+  warm encode executable (or ``warm_plan``) serves update traffic with
+  zero extra compiles, and the bucket ladder absorbs the small ragged
+  widths partial-stripe edits produce.  The ``op`` split lives in the
+  metrics (``segments_dispatched{op="update"}``, ``rs_codec_bytes_total``)
+  rather than the cache key — compile classes stay shape-pure.
 
 Env knobs (all read per call, so tests can monkeypatch):
 

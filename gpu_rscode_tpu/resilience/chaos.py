@@ -85,6 +85,9 @@ _PINNED_ENV = {
     # an ambient RS_LOCATE=off would flip every recoverable silent
     # iteration to a failure.  Pin the default (auto).
     "RS_LOCATE": None,
+    # The update class drives the crash knob itself, per scheduled op;
+    # an ambient value would tear every un-scheduled update too.
+    "RS_UPDATE_CRASH": None,
 }
 
 
@@ -182,6 +185,77 @@ def plan_silent_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
         "size": size,
         "events": events,
         "faults": "",
+    }
+
+
+def plan_update_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
+    """The ``update`` workload class (``rs chaos --update``): a random
+    schedule of in-place edits, appends and TORN ops (RS_UPDATE_CRASH
+    at a random stage) against one archive, on its own derived seed
+    stream (``rs-chaos-update:*`` — classic/silent digests unchanged).
+
+    Validation per the ROADMAP's stated contract: after the schedule,
+    the delta-updated/appended archive must be byte-identical — every
+    chunk file AND every CRC line — to a from-scratch full re-encode of
+    the final logical bytes, scrub must report it fully healthy, and
+    auto-decode must return exactly those bytes.  Every torn op must
+    roll back to the byte-exact pre-op archive via the journal.
+    """
+    rng = random.Random(f"rs-chaos-update:{seed}:{i}")
+    k = rng.randint(2, 6)
+    p = rng.randint(1, 3)
+    w = 16 if rng.random() < 0.2 else 8
+    layout = "interleaved" if rng.random() < 0.6 else "row"
+    size = rng.randint(64, max_bytes)
+    sym = w // 8
+    from ..utils.fileformat import chunk_size_for_layout
+
+    chunk0 = chunk_size_for_layout(size, k, sym, layout)
+    total = size
+    ops = []
+    for _ in range(rng.randint(1, 5)):
+        kinds = ["update", "update", "crash_update"]
+        if layout == "interleaved":
+            kinds += ["append", "append", "crash_append"]
+        else:
+            # Row-major appends are slack-bounded: schedule one only
+            # while it provably fits (chunk size unchanged).
+            if k * chunk0 - total > 0:
+                kinds.append("append")
+        kind = rng.choice(kinds)
+        if kind.endswith("update"):
+            at = rng.randrange(0, total)
+            ln = rng.randint(1, min(4096, total - at))
+            op = {"op": "update", "at": at, "len": ln}
+        else:
+            ln = (
+                rng.randint(1, 4096) if layout == "interleaved"
+                else rng.randint(1, k * chunk0 - total)
+            )
+            op = {"op": "append", "len": ln}
+        if kind.startswith("crash"):
+            op["crash"] = rng.choice(
+                ["after_journal", "mid_patch", "before_commit"]
+            )
+        elif op["op"] == "append":
+            total += ln
+        ops.append(op)
+    faults = ""
+    if rng.random() < 0.3:
+        # Transient write hiccups on the patch lane: the bounded retry
+        # plane must absorb them without changing any verdict.
+        faults = "write:delay@ms=1,p=0.05"
+    return {
+        "seed": seed,
+        "iter": i,
+        "mode": "update",
+        "k": k,
+        "p": p,
+        "w": w,
+        "layout": layout,
+        "size": size,
+        "events": ops,
+        "faults": faults,
     }
 
 
@@ -402,7 +476,169 @@ def run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
     with _pinned_env():
         if cfg.get("mode") == "silent":
             return _run_silent_iteration(cfg, workdir, keep=keep)
+        if cfg.get("mode") == "update":
+            return _run_update_iteration(cfg, workdir, keep=keep)
         return _run_iteration(cfg, workdir, keep=keep)
+
+
+def _archive_snapshot(fname: str, n: int) -> list[bytes]:
+    """Every chunk file's bytes plus .METADATA — the byte-exact rollback
+    witness for torn update/append ops."""
+    from ..utils.fileformat import chunk_file_name, metadata_file_name
+
+    out = []
+    for c in range(n):
+        path = chunk_file_name(fname, c)
+        out.append(open(path, "rb").read() if os.path.exists(path) else None)
+    out.append(open(metadata_file_name(fname), "rb").read())
+    return out
+
+
+def _run_update_iteration(cfg: dict, workdir: str, *,
+                          keep: bool = False) -> dict:
+    """One ``update``-class iteration: encode, run the scheduled mix of
+    edits / appends / torn ops, and prove the delta math against a
+    from-scratch re-encode twin (chunk files AND CRC lines byte-equal),
+    plus byte-exact journal rollback for every torn op."""
+    from .. import api
+    from ..update import SimulatedCrash
+    from ..update.journal import journal_path
+    from ..utils.fileformat import (
+        chunk_file_name, metadata_file_name, read_archive_meta,
+    )
+
+    seed, i = cfg["seed"], cfg["iter"]
+    k, p, w, size = cfg["k"], cfg["p"], cfg["w"], cfg["size"]
+    layout = cfg["layout"]
+    base = os.path.join(workdir, f"iter{i}")
+    os.makedirs(base, exist_ok=True)
+    fname = os.path.join(base, f"chaos_update_{i}.bin")
+    data = random.Random(f"rs-chaos-data:{seed}:{i}").randbytes(size)
+    ok = False
+    try:
+        with open(fname, "wb") as fp:
+            fp.write(data)
+        api.encode_file(
+            fname, k, p, checksums=True, w=w, layout=layout,
+            segment_bytes=_SEGMENT_BYTES,
+        )
+        mirror = bytearray(data)
+        plan = (
+            _faults.parse_plan(cfg["faults"], seed=(seed * 1_000_003 + i))
+            if cfg["faults"] else None
+        )
+        _retry.reset_budget()
+        with _faults.activate(plan) if plan else nullcontext():
+            for j, op in enumerate(cfg["events"]):
+                payload = random.Random(
+                    f"rs-chaos-update-data:{seed}:{i}:{j}"
+                ).randbytes(op["len"])
+                crash = op.get("crash")
+                if crash:
+                    pre = _archive_snapshot(fname, k + p)
+                    os.environ["RS_UPDATE_CRASH"] = crash
+                    try:
+                        if op["op"] == "update":
+                            api.update_file(
+                                fname, op["at"], payload,
+                                segment_bytes=_SEGMENT_BYTES,
+                            )
+                        else:
+                            api.append_file(
+                                fname, payload,
+                                segment_bytes=_SEGMENT_BYTES,
+                            )
+                        _check(False, cfg,
+                               f"crash stage {crash} did not fire (op {j})")
+                    except SimulatedCrash:
+                        pass
+                    finally:
+                        os.environ.pop("RS_UPDATE_CRASH", None)
+                    _check(os.path.exists(journal_path(fname)), cfg,
+                           f"torn op {j} left no journal")
+                    verdict = api.recover_archive(fname)
+                    _check(verdict == "rolled_back", cfg,
+                           f"recovery verdict {verdict!r} on torn op {j}")
+                    _check(_archive_snapshot(fname, k + p) == pre, cfg,
+                           f"torn op {j} did not roll back byte-exact")
+                elif op["op"] == "update":
+                    api.update_file(
+                        fname, op["at"], payload,
+                        segment_bytes=_SEGMENT_BYTES,
+                    )
+                    mirror[op["at"] : op["at"] + op["len"]] = payload
+                else:
+                    api.append_file(
+                        fname, payload, segment_bytes=_SEGMENT_BYTES
+                    )
+                    mirror += payload
+                report = api.scan_file(
+                    fname, segment_bytes=_SEGMENT_BYTES
+                )
+                _check(
+                    report["decodable"] is True
+                    and not report["corrupt"] and not report["missing"]
+                    and not report["pending_journal"],
+                    cfg, f"archive unhealthy after op {j}: {report}",
+                )
+        # The ROADMAP's stated validation: the delta-updated archive is
+        # differential-checked byte-identical against a from-scratch
+        # full re-encode of the final logical bytes.
+        twin = os.path.join(base, f"twin_{i}.bin")
+        with open(twin, "wb") as fp:
+            fp.write(bytes(mirror))
+        api.encode_file(
+            twin, k, p, checksums=True, w=w, layout=layout,
+            segment_bytes=_SEGMENT_BYTES,
+        )
+        for c in range(k + p):
+            got = open(chunk_file_name(fname, c), "rb").read()
+            want = open(chunk_file_name(twin, c), "rb").read()
+            _check(got == want, cfg,
+                   f"delta-updated chunk {c} != full re-encode twin")
+        ma = read_archive_meta(metadata_file_name(fname))
+        mb = read_archive_meta(metadata_file_name(twin))
+        _check(ma.crcs == mb.crcs and ma.total_size == mb.total_size, cfg,
+               "metadata CRCs/size diverge from the re-encode twin")
+        out = api.auto_decode_file(
+            fname, fname + ".dec", segment_bytes=_SEGMENT_BYTES
+        )
+        _check(open(out, "rb").read() == bytes(mirror), cfg,
+               "decode != tracked logical bytes after the schedule")
+        ok = True
+    except ChaosFailure:
+        raise
+    except Exception as e:
+        raise ChaosFailure(
+            cfg, f"unexpected {type(e).__name__}: {e}"
+        ) from e
+    finally:
+        verdict = "pass" if ok else "fail"
+        _metrics.counter(
+            "rs_chaos_iterations_total", "chaos-harness iteration verdicts"
+        ).labels(verdict=verdict).inc()
+        if _runlog.enabled():
+            _runlog.record({
+                "op": "chaos_iter",
+                "config": {"k": k, "n": k + p, "w": w},
+                "bytes": size,
+                "chaos": {
+                    "seed": seed, "iter": i, "mode": "update",
+                    "layout": layout, "events": cfg["events"],
+                    "faults": cfg["faults"],
+                },
+                "outcome": "ok" if ok else "error",
+            })
+        if ok and not keep:
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "iter": i, "mode": "update", "layout": layout,
+        "k": k, "p": p, "w": w, "size": size,
+        "ops": [op["op"] + (":torn" if op.get("crash") else "")
+                for op in cfg["events"]],
+        "final_size": len(mirror),
+        "faults": cfg["faults"], "verdict": "pass",
+    }
 
 
 def _run_silent_iteration(cfg: dict, workdir: str, *,
@@ -746,6 +982,13 @@ def main(argv: list[str] | None = None) -> int:
                     "bitrot recovered (or refused) by the error-locating "
                     "decode path — own seed stream, classic schedules "
                     "unchanged")
+    ap.add_argument("--update", action="store_true",
+                    help="run the UPDATE workload class: random edit/"
+                    "append/torn-op schedules, every archive "
+                    "differential-checked byte-identical against a "
+                    "from-scratch re-encode and every torn op rolled "
+                    "back via the journal — own seed stream "
+                    "(docs/UPDATE.md)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line per iteration")
     ap.add_argument("--keep", action="store_true",
@@ -768,8 +1011,16 @@ def main(argv: list[str] | None = None) -> int:
             print(f"rs chaos: bad --repro JSON: {e}", file=sys.stderr)
             return 2
     else:
+        if args.silent and args.update:
+            print("rs chaos: --silent and --update conflict; pick one "
+                  "workload class", file=sys.stderr)
+            return 2
         indices = [args.only] if args.only is not None else range(args.iters)
-        plan = plan_silent_iteration if args.silent else plan_iteration
+        plan = (
+            plan_update_iteration if args.update
+            else plan_silent_iteration if args.silent
+            else plan_iteration
+        )
         cfgs = [plan(args.seed, i, args.max_bytes) for i in indices]
     schedule_digest = _digest(cfgs)
 
@@ -783,9 +1034,9 @@ def main(argv: list[str] | None = None) -> int:
             )
             line = json.dumps(shrunk, sort_keys=True)
             print(f"rs chaos: FAILED — {e.what}", file=sys.stderr)
-            silent_flag = (
-                "--silent " if cfg.get("mode") == "silent" else ""
-            )
+            silent_flag = {
+                "silent": "--silent ", "update": "--update ",
+            }.get(cfg.get("mode"), "")
             print(
                 f"rs chaos: replay the original with: rs chaos "
                 f"{silent_flag}--seed {cfg['seed']} --only {cfg['iter']}",

@@ -13,6 +13,14 @@ One long-lived process in front of the warm plan cache (docs/SERVE.md):
   named archive; the response body streams the rebuilt file bytes.
 * ``POST /scrub?name=N[&syndrome=1]`` — read-only health report
   (``api.scan_file``) as JSON.
+* ``POST /update?name=N&at=OFF`` / ``POST /append?name=N`` — delta-
+  parity partial-stripe writes against a stored archive
+  (docs/UPDATE.md): the body is the replacement/append bytes, applied
+  via ``api.update_file`` / ``api.append_file`` (only the touched
+  segment columns move; crash-atomic journal + generation commit).
+  They ride the same admission/DRR/deadline plane, costed by payload
+  size; encode accepts ``layout=interleaved`` to create archives that
+  take unbounded appends.
 * ``GET /healthz`` ``/metrics`` ``/stats`` — liveness JSON, Prometheus
   exposition of the live registry, queue/batcher introspection.
 
@@ -148,7 +156,9 @@ class _Handler(BaseHTTPRequestHandler):
         url = urlparse(self.path)
         query = parse_qs(url.query)
         try:
-            if url.path not in ("/encode", "/decode", "/scrub"):
+            if url.path not in (
+                "/encode", "/decode", "/scrub", "/update", "/append"
+            ):
                 self._send_error_json(404, f"no such path {url.path}")
                 return
             try:
@@ -208,6 +218,12 @@ class _Handler(BaseHTTPRequestHandler):
             if w not in (8, 16):
                 self._send_error_json(400, f"w must be 8 or 16, got {w}")
                 return None
+            enc_layout = _q1(query, "layout", "row")
+            if enc_layout not in ("row", "interleaved"):
+                self._send_error_json(
+                    400, f"layout must be row or interleaved, "
+                    f"got {enc_layout!r}")
+                return None
             # Per-request temp: concurrent same-name uploads must never
             # interleave bytes in one file.  The executor promotes it
             # onto the spool path under the per-name lock.
@@ -223,7 +239,42 @@ class _Handler(BaseHTTPRequestHandler):
                 generator=_q1(query, "generator", "vandermonde"),
                 checksums=_q1(query, "checksum", "1") != "0",
                 keep=_q1(query, "keep", "0") == "1",
-                cost=nbytes, deadline=deadline,
+                layout=enc_layout, cost=nbytes, deadline=deadline,
+            )
+            req.upload = upload
+        elif op in ("update", "append"):
+            # Partial-stripe write traffic (docs/UPDATE.md): the body is
+            # the delta/append payload, spooled to a per-request temp;
+            # shape key + DRR cost come from the body size and the
+            # archive's own metadata (404s garbage names pre-queue).
+            try:
+                k, p, w, _total = daemon.archive_shape(spool)
+            except FileNotFoundError:
+                self._send_error_json(
+                    404, f"no archive {name!r} for tenant {tenant!r}")
+                return None
+            except (OSError, ValueError) as e:
+                self._send_error_json(400, f"unreadable archive: {e}")
+                return None
+            at = 0
+            if op == "update":
+                try:
+                    at = int(_q1(query, "at", ""))
+                except (TypeError, ValueError):
+                    self._send_error_json(
+                        400, "update needs an integer at= byte offset")
+                    return None
+            upload = f"{spool}.up.{daemon.next_upload_id()}"
+            nbytes = self._read_body_to(upload)
+            if nbytes == 0:
+                os.unlink(upload)
+                self._send_error_json(
+                    400, f"refusing a zero-byte {op} payload")
+                return None
+            req = Request(
+                op, tenant, name, spool, k=k, p=p, w=w,
+                strategy=_q1(query, "strategy", "auto"),
+                at=at, cost=nbytes, deadline=deadline,
             )
             req.upload = upload
         else:
@@ -315,6 +366,8 @@ class _Handler(BaseHTTPRequestHandler):
                 payload["bytes"] = req.cost
                 payload["files"] = [
                     os.path.basename(f) for f in (req.result or [])]
+            elif req.op in ("update", "append"):
+                payload["update"] = req.result  # the engine's op summary
             else:  # scrub
                 payload["report"] = req.result
             self._send_json(200, payload)
@@ -633,6 +686,7 @@ class ServeDaemon:
                         [r.spool for r in live], lead.k, lead.p,
                         generator=lead.generator, strategy=lead.strategy,
                         checksums=lead.checksums, w=lead.w,
+                        layout=lead.layout,
                     )
                     for r in live:
                         self._finish_encode(r, results[r.spool])
@@ -674,7 +728,7 @@ class ServeDaemon:
                         req.spool, req.k, req.p,
                         generator=req.generator,
                         strategy=req.strategy, checksums=req.checksums,
-                        w=req.w,
+                        w=req.w, layout=req.layout,
                     )
                     self._finish_encode(req, files)
                 elif req.op == "decode":
@@ -683,6 +737,21 @@ class ServeDaemon:
                         strategy=req.strategy,
                     )
                     self._finish(req, "ok", result=out)
+                elif req.op in ("update", "append"):
+                    # The upload temp IS the payload (never promoted onto
+                    # the spool — the archive's chunks are the target).
+                    if req.op == "update":
+                        summary = api.update_file(
+                            req.spool, req.at, src=req.upload,
+                            strategy=req.strategy,
+                        )
+                    else:
+                        summary = api.append_file(
+                            req.spool, src=req.upload,
+                            strategy=req.strategy,
+                        )
+                    self.discard_upload(req)
+                    self._finish(req, "ok", result=summary)
                 else:  # scrub
                     report = api.scan_file(req.spool,
                                            syndrome=req.syndrome)

@@ -131,9 +131,12 @@ def _parse_tenants(spec: str) -> list[tuple[str, float]]:
 
 
 def _schedule(duration_s: float, rate: float, tenants, decode_frac: float,
-              seed: int) -> list[tuple[float, str, str]]:
+              seed: int, update_frac: float = 0.0) -> list:
     """The full open-loop arrival plan, drawn up front (seeded — the same
-    offered load replays exactly)."""
+    offered load replays exactly).  ``update_frac`` mixes in partial-
+    stripe writes (``POST /update`` of a small random range of an
+    archive the tenant already encoded) — the mixed read/write tenant
+    traffic of the object-store/journal workload class."""
     rng = random.Random(seed)
     names = [t for t, _ in tenants]
     weights = [w for _, w in tenants]
@@ -144,16 +147,24 @@ def _schedule(duration_s: float, rate: float, tenants, decode_frac: float,
         if t >= duration_s:
             return plan
         tenant = rng.choices(names, weights)[0]
-        op = "decode" if rng.random() < decode_frac else "encode"
+        roll = rng.random()
+        if roll < decode_frac:
+            op = "decode"
+        elif roll < decode_frac + update_frac:
+            op = "update"
+        else:
+            op = "encode"
         plan.append((t, tenant, op))
 
 
 def run_open_loop(base_url: str, *, duration_s: float, rate: float,
                   tenants: list[tuple[str, float]], size_bytes: int,
                   k: int, p: int, w: int = 8, decode_frac: float = 0.3,
-                  seed: int = 0, quiet: bool = False) -> dict:
+                  update_frac: float = 0.0, seed: int = 0,
+                  quiet: bool = False) -> dict:
     """Drive the daemon at ``base_url``; returns the summary document."""
-    plan = _schedule(duration_s, rate, tenants, decode_frac, seed)
+    plan = _schedule(duration_s, rate, tenants, decode_frac, seed,
+                     update_frac)
     rec = _Recorder()
     # One shared payload buffer per size (arrival threads must not spend
     # their schedule slot generating bytes); per-request uniqueness comes
@@ -163,13 +174,16 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
     encoded: dict[str, list[str]] = {t: [] for t, _ in tenants}
     enc_lock = threading.Lock()
 
+    delta_len = max(1, min(4096, size_bytes))
+    delta_body = random.Random(seed ^ 0xDE17A).randbytes(delta_len)
+
     def fire(i: int, tenant: str, op: str) -> None:
-        if op == "decode":
+        if op in ("decode", "update"):
             with enc_lock:
                 pool = encoded[tenant]
                 name = pool[i % len(pool)] if pool else None
             if name is None:
-                op = "encode"  # nothing of ours to decode yet
+                op = "encode"  # nothing of ours to write against yet
         if op == "encode":
             name = f"lg{seed}_{tenant}_{i}.bin"
             t0 = time.monotonic()
@@ -181,6 +195,17 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
             if status == 200:
                 with enc_lock:
                     encoded[tenant].append(name)
+        elif op == "update":
+            # A small hot write against a large cold archive — the
+            # workload class rs update exists for.  Deterministic offset
+            # per arrival index keeps the run replayable.
+            at = (i * 7919) % max(1, size_bytes - delta_len + 1)
+            t0 = time.monotonic()
+            status, _ = _post(
+                f"{base_url}/update?name={name}&at={at}", tenant,
+                delta_body)
+            rec.record(tenant, "update", status,
+                       time.monotonic() - t0, delta_len)
         else:
             t0 = time.monotonic()
             status, payload = _post(f"{base_url}/decode?name={name}",
@@ -215,7 +240,8 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
         **totals,
         "config": {"k": k, "n": k + p, "w": w,
                    "size_bytes": size_bytes, "rate": rate,
-                   "decode_frac": decode_frac, "seed": seed,
+                   "decode_frac": decode_frac,
+                   "update_frac": update_frac, "seed": seed,
                    "tenants": dict(tenants)},
     }
     if not quiet:
@@ -362,6 +388,10 @@ def main(argv=None) -> int:
                     help="encode payload size (default 64)")
     ap.add_argument("--decode-frac", type=float, default=0.3,
                     help="fraction of arrivals that decode (default 0.3)")
+    ap.add_argument("--update-frac", type=float, default=0.0,
+                    help="fraction of arrivals that POST /update a small "
+                    "byte range of an archive the tenant already encoded "
+                    "(mixed read/write traffic; default 0)")
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--n", type=int, default=6)
     ap.add_argument("--w", type=int, default=8, choices=(8, 16))
@@ -439,6 +469,7 @@ def main(argv=None) -> int:
                     tenants=_parse_tenants(args.tenants),
                     size_bytes=args.size_kb * 1024, k=args.k, p=p,
                     w=args.w, decode_frac=args.decode_frac,
+                    update_frac=args.update_frac,
                     seed=args.seed, quiet=args.json)
                 if args.faults:
                     # Self-describing capture: a faulted run's error rows

@@ -54,14 +54,15 @@ class Request:
     __slots__ = (
         "op", "tenant", "name", "spool", "upload", "k", "p", "w",
         "strategy", "generator", "checksums", "syndrome", "keep", "cost",
-        "seq", "arrival", "deadline", "batch_size", "queue_wait_s",
-        "service_s", "outcome", "result", "error", "done",
+        "at", "layout", "seq", "arrival", "deadline", "batch_size",
+        "queue_wait_s", "service_s", "outcome", "result", "error", "done",
     )
 
     def __init__(self, op: str, tenant: str, name: str, spool: str, *,
                  k: int = 0, p: int = 0, w: int = 8, strategy: str = "auto",
                  generator: str = "vandermonde", checksums: bool = True,
                  syndrome: bool = False, keep: bool = False,
+                 at: int = 0, layout: str = "row",
                  cost: int = 1, deadline: float | None = None):
         self.op = op
         self.tenant = tenant
@@ -77,6 +78,8 @@ class Request:
         self.checksums = checksums
         self.syndrome = syndrome
         self.keep = keep
+        self.at = int(at)         # update: byte offset of the edit
+        self.layout = layout      # encode: chunk layout (docs/UPDATE.md)
         self.cost = max(1, int(cost))
         self.seq = 0  # assigned at submit (admission order)
         self.arrival = time.monotonic()
@@ -94,7 +97,7 @@ class Request:
         requests sharing a key share one warm AOT executable, so the
         batcher coalesces exactly along it."""
         return (self.op, self.k, self.p, self.w, self.strategy,
-                self.generator)
+                self.generator, self.layout)
 
     def sort_key(self) -> tuple:
         # Earliest deadline first; deadline-less requests behind any

@@ -1,0 +1,175 @@
+"""``tools/update_bench.py --ab`` — delta update vs full re-encode.
+
+The acceptance measurement for the update subsystem (docs/UPDATE.md): a
+small (≤ 1 segment) edit to a large archive through ``rs update`` must
+beat re-encoding the whole file by ≥ 10x — the wall-clock translation of
+"only the touched segment columns move".
+
+A/B discipline (matching tools/io_bench.py): paired, interleaved
+best-of-``--trials`` — each trial applies the SAME edit through (a)
+``api.update_file`` against the standing archive and (b) a from-scratch
+``api.encode_file`` of the edited file — so machine noise hits both arms
+alike.  Re-applying an identical edit still pays every real cost (old
+reads, the E·Δ dispatch, parity pwrites, CRC math, metadata commit), so
+trial repetition is honest.  The capture row records the speedup plus
+both arms' wall decomposition; ``bench_captures/update_ab_*.jsonl``
+joins the BENCH trajectory via the shared ``capture_header``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def run_ab(
+    *,
+    size_mb: int,
+    edit_kb: int,
+    k: int,
+    p: int,
+    w: int,
+    layout: str,
+    trials: int,
+    workdir: str,
+    segment_bytes: int | None = None,
+    quiet: bool = False,
+) -> list[dict]:
+    import numpy as np
+
+    from .. import api
+
+    rng = np.random.default_rng(20260804)
+    size = size_mb * 1024 * 1024
+    edit = edit_kb * 1024
+    path = os.path.join(workdir, "update_ab.bin")
+    data = rng.integers(0, 256, size=size, dtype=np.uint8)
+    data.tofile(path)
+    kwargs = {}
+    if segment_bytes:
+        kwargs["segment_bytes"] = segment_bytes
+    api.encode_file(path, k, p, checksums=True, w=w, layout=layout,
+                    **kwargs)
+
+    # One mid-file edit ≤ 1 segment wide, fixed across trials (paired).
+    at = size // 2 + 1
+    delta = rng.integers(0, 256, size=edit, dtype=np.uint8).tobytes()
+    edited = os.path.join(workdir, "update_ab_edited.bin")
+    data[at : at + edit] = np.frombuffer(delta, dtype=np.uint8)
+    data.tofile(edited)
+
+    update_walls, reencode_walls = [], []
+    for _ in range(max(1, trials)):
+        t0 = time.perf_counter()
+        summary = api.update_file(path, at, delta, **kwargs)
+        update_walls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        api.encode_file(edited, k, p, checksums=True, w=w, layout=layout,
+                        **kwargs)
+        reencode_walls.append(time.perf_counter() - t0)
+
+    up, re_ = min(update_walls), min(reencode_walls)
+    rows = [
+        {
+            "kind": "update_ab",
+            "layout": layout,
+            "size_bytes": size,
+            "edit_bytes": edit,
+            "config": {"k": k, "n": k + p, "w": w},
+            "trials": trials,
+            "update_wall_s": round(up, 6),
+            "reencode_wall_s": round(re_, 6),
+            "update_walls_s": [round(x, 6) for x in update_walls],
+            "reencode_walls_s": [round(x, 6) for x in reencode_walls],
+            "speedup": round(re_ / up, 3) if up else None,
+            "segments_touched": summary["segments"],
+            "chunks_touched": summary["chunks_touched"],
+        }
+    ]
+    if not quiet:
+        print(
+            f"update_bench: {layout} {size_mb}MiB archive, {edit_kb}KiB "
+            f"edit -> update {up:.4f}s vs re-encode {re_:.4f}s = "
+            f"{re_ / up:.1f}x",
+            file=sys.stderr,
+        )
+    return rows
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ..obs import runlog as _runlog
+
+    ap = argparse.ArgumentParser(
+        prog="update_bench",
+        description="A/B: rs update of a small edit vs full re-encode of "
+        "a large archive (paired best-of-trials; docs/UPDATE.md).",
+    )
+    ap.add_argument("--ab", action="store_true",
+                    help="run the A/B comparison (the only mode)")
+    ap.add_argument("--size-mb", type=int, default=64,
+                    help="archive size in MiB (default 64)")
+    ap.add_argument("--edit-kb", type=int, default=64,
+                    help="edit size in KiB (default 64 — well under one "
+                    "segment)")
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--p", type=int, default=2)
+    ap.add_argument("--w", type=int, default=8, choices=(8, 16))
+    ap.add_argument("--layouts", default="row,interleaved",
+                    help="comma list of chunk layouts to measure")
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--segment-bytes", type=int, default=None)
+    ap.add_argument("--dir", default=None,
+                    help="work directory (default: a fresh temp dir)")
+    ap.add_argument("--capture", default=None,
+                    help="capture JSONL path (default bench_captures/"
+                    "update_ab_<backend>_<ts>.jsonl; '-' disables)")
+    ap.add_argument("--json", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    if not args.ab:
+        print("update_bench: pass --ab (the A/B comparison is the bench)",
+              file=sys.stderr)
+        return 2
+
+    rows: list[dict] = []
+    with tempfile.TemporaryDirectory(prefix="rs_update_ab_") as tmp:
+        workdir = args.dir or tmp
+        os.makedirs(workdir, exist_ok=True)
+        for layout in [s.strip() for s in args.layouts.split(",") if s]:
+            rows += run_ab(
+                size_mb=args.size_mb, edit_kb=args.edit_kb,
+                k=args.k, p=args.p, w=args.w, layout=layout,
+                trials=args.trials, workdir=workdir,
+                segment_bytes=args.segment_bytes, quiet=args.json,
+            )
+
+    capture = args.capture
+    if capture is None:
+        os.makedirs("bench_captures", exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        capture = os.path.join(
+            "bench_captures",
+            f"update_ab_{_runlog.backend_name() or 'cpu'}_{stamp}.jsonl",
+        )
+    if capture != "-":
+        with open(capture, "w") as fp:
+            fp.write(
+                json.dumps(_runlog.capture_header("update_bench")) + "\n"
+            )
+            for row in rows:
+                fp.write(json.dumps(row) + "\n")
+        print(f"update_bench: capture -> {capture}", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"rows": rows, "capture": capture}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

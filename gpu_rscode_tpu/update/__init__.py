@@ -1,0 +1,55 @@
+"""Delta-parity partial-stripe updates and append-mode encoding.
+
+RS over GF(2^w) is linear, so a byte-range edit of the original file
+needs only the touched symbol columns to move: with ``E`` the parity
+coefficient block of the archive's total matrix and ``Δ = new ⊕ old``
+the native-symbol delta, ``parity' = parity ⊕ E·Δ`` — the XOR-patching
+regime of the XOR-based erasure-coding literature (arXiv 2108.02692,
+1701.07731).  This package is that capability end to end
+(docs/UPDATE.md):
+
+* :func:`~.engine.apply_update` / :func:`~.engine.apply_append` — the
+  shared patch engine behind ``api.update_file`` / ``api.append_file``:
+  byte range → touched column windows (both chunk layouts), Δ assembly,
+  ``E·Δ`` as a plan-cached GF-GEMM (``codec.update``, op="update"),
+  in-place parity XOR patches through an ordered pwrite lane, and
+  incremental per-chunk CRC fix-up (:mod:`.crc` — no full-chunk
+  re-hash).
+* :mod:`.journal` — the undo journal that makes in-place mutation
+  crash-atomic: old bytes of every region land (fsynced) in
+  ``<archive>.rs_journal`` before any patch; the atomic .METADATA
+  rewrite (generation bump) is the commit point; recovery rolls a torn
+  update/append back to the pre-op archive.
+* :mod:`.layout` — the ``interleaved`` chunk-layout extension (file
+  symbol s → row ``s % k``, column ``s // k``): appends touch only the
+  tail column block, so ``rs append`` grows an archive without reading
+  a single cold byte.  Row-major (reference-layout) archives take delta
+  updates too, and appends bounded by their tail-padding slack.
+"""
+
+from __future__ import annotations
+
+from .crc import crc32_append, crc32_combine, crc32_patch, crc32_zeros
+from .engine import (
+    SimulatedCrash,
+    UpdateError,
+    apply_append,
+    apply_update,
+)
+from .journal import journal_path, recover
+from .layout import deinterleave, interleave
+
+__all__ = [
+    "SimulatedCrash",
+    "UpdateError",
+    "apply_append",
+    "apply_update",
+    "crc32_append",
+    "crc32_combine",
+    "crc32_patch",
+    "crc32_zeros",
+    "deinterleave",
+    "interleave",
+    "journal_path",
+    "recover",
+]

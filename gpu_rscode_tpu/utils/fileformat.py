@@ -43,6 +43,45 @@ def chunk_size_for(total_size: int, native_num: int, sym: int = 1) -> int:
     return -(-chunk // sym) * sym
 
 
+def chunk_size_for_layout(
+    total_size: int, native_num: int, sym: int = 1, layout: str = "row"
+) -> int:
+    """Bytes per chunk under either chunk layout.
+
+    ``row`` (reference-compatible): chunk i holds the contiguous file
+    range [i*chunk, (i+1)*chunk).  ``interleaved`` (extension, recorded
+    as ``# layout interleaved`` in .METADATA): file symbol s lives in row
+    ``s % k`` at column ``s // k``, so every chunk holds
+    ``ceil(total / (k*sym))`` symbols and APPENDING to the file only
+    touches the tail column block of every chunk — the append-mode
+    layout (docs/UPDATE.md)."""
+    if layout == "interleaved":
+        if total_size == 0:
+            return 0
+        cols = -(-total_size // (native_num * sym))  # ceil, in symbols
+        return cols * sym
+    return chunk_size_for(total_size, native_num, sym)
+
+
+def fsync_dir(path: str) -> None:
+    """fsync the directory CONTAINING ``path`` (best-effort: some
+    filesystems refuse O_RDONLY dir fds).  POSIX gives renames/unlinks
+    no durability ordering without it — the update/append commit
+    protocol needs the .METADATA rename on disk before the undo journal
+    may disappear (docs/UPDATE.md)."""
+    d = os.path.dirname(os.path.abspath(path))
+    try:
+        fd = os.open(d, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
 def write_metadata(
     path: str,
     total_size: int,
@@ -50,6 +89,7 @@ def write_metadata(
     native_num: int,
     total_mat: np.ndarray,
     w: int = 8,
+    layout: str = "row",
 ) -> None:
     rows = native_num + parity_num
     assert total_mat.shape == (rows, native_num), total_mat.shape
@@ -62,6 +102,11 @@ def write_metadata(
             # Wide-symbol extension line (same trailing-comment scheme as the
             # CRC32 lines: invisible to the fixed-token reference parser).
             fp.write(f"# gfwidth {w}\n")
+        if layout != "row":
+            # Chunk-layout extension (docs/UPDATE.md): interleaved archives
+            # support unbounded `rs append`.  Absent == the reference's
+            # row-contiguous striping, keeping base encodes byte-identical.
+            fp.write(f"# layout {layout}\n")
 
 
 def _parse_field_width(text: str) -> int:
@@ -70,6 +115,95 @@ def _parse_field_width(text: str) -> int:
         if len(parts) == 3 and parts[:2] == ["#", "gfwidth"] and parts[2].isdigit():
             return int(parts[2])
     return 8
+
+
+def _parse_layout(text: str) -> str:
+    for line in text.splitlines():
+        parts = line.split()
+        if len(parts) == 3 and parts[:2] == ["#", "layout"]:
+            if parts[2] not in ("row", "interleaved"):
+                raise ValueError(
+                    f"unsupported chunk layout {parts[2]!r} "
+                    "(this build handles row and interleaved)"
+                )
+            return parts[2]
+    return "row"
+
+
+def _parse_generation(text: str) -> int:
+    for line in text.splitlines():
+        parts = line.split()
+        if (
+            len(parts) == 3
+            and parts[:2] == ["#", "generation"]
+            and parts[2].isdigit()
+        ):
+            return int(parts[2])
+    return 0
+
+
+def read_layout(path: str) -> str:
+    """Chunk layout of a metadata file: the ``# layout`` extension line,
+    or ``row`` (the reference's only layout) when absent."""
+    with open(path) as fp:
+        return _parse_layout(fp.read())
+
+
+class ArchiveMeta:
+    """One-read view of an archive's .METADATA including every extension
+    line — the object the update/append subsystem (and layout-aware
+    decode paths) work from.  ``read_metadata_ext`` keeps its 6-tuple
+    surface for the base-format callers."""
+
+    __slots__ = (
+        "path", "total_size", "parity_num", "native_num", "total_mat",
+        "w", "crcs", "layout", "generation",
+    )
+
+    def __init__(self, path, total_size, parity_num, native_num, total_mat,
+                 w, crcs, layout, generation):
+        self.path = path
+        self.total_size = total_size
+        self.parity_num = parity_num
+        self.native_num = native_num
+        self.total_mat = total_mat
+        self.w = w
+        self.crcs = crcs
+        self.layout = layout
+        self.generation = generation
+
+    @property
+    def sym(self) -> int:
+        return self.w // 8
+
+    @property
+    def chunk(self) -> int:
+        return chunk_size_for_layout(
+            self.total_size, self.native_num, self.sym, self.layout
+        )
+
+
+def read_archive_meta(path: str) -> ArchiveMeta:
+    """Parse .METADATA into an :class:`ArchiveMeta` (base fields plus the
+    ``# gfwidth`` / ``# crc32`` / ``# layout`` / ``# generation``
+    extension lines)."""
+    with open(path) as fp:
+        text = fp.read()
+    total_size, parity_num, native_num, mat = _parse_metadata(text, path)
+    w = _parse_field_width(text)
+    # Width-aware chunk cap (the parse-time cap only enforces the widest
+    # field's 65536): a w=8 header declaring n > 256 would regenerate a
+    # Vandermonde with repeated evaluation points — singular submatrices
+    # and wrong recoveries, not a clear error.
+    if native_num + parity_num > (1 << w):
+        raise ValueError(
+            f"metadata declares n={native_num + parity_num} chunks in "
+            f"{path!r} but GF(2^{w}) supports at most {1 << w}"
+        )
+    return ArchiveMeta(
+        path, total_size, parity_num, native_num, mat, w,
+        _parse_checksums(text), _parse_layout(text), _parse_generation(text),
+    )
 
 
 def read_field_width(path: str) -> int:
@@ -84,28 +218,12 @@ def read_metadata_ext(path: str):
 
     Returns ``(total_size, parity_num, native_num, total_matrix, w, crcs)``
     — the base-format fields plus the ``# gfwidth`` width (8 when absent)
-    and the ``# crc32`` checksum dict ({} when absent)."""
-    with open(path) as fp:
-        text = fp.read()
-    total_size, parity_num, native_num, mat = _parse_metadata(text, path)
-    w = _parse_field_width(text)
-    # Width-aware chunk cap (the parse-time cap only enforces the widest
-    # field's 65536): a w=8 header declaring n > 256 would regenerate a
-    # Vandermonde with repeated evaluation points — singular submatrices
-    # and wrong recoveries, not a clear error.
-    if native_num + parity_num > (1 << w):
-        raise ValueError(
-            f"metadata declares n={native_num + parity_num} chunks in "
-            f"{path!r} but GF(2^{w}) supports at most {1 << w}"
-        )
-    return (
-        total_size,
-        parity_num,
-        native_num,
-        mat,
-        w,
-        _parse_checksums(text),
-    )
+    and the ``# crc32`` checksum dict ({} when absent).  Thin 6-tuple shim
+    over :func:`read_archive_meta` (the one parse pipeline) for callers
+    that predate the layout/generation extensions."""
+    m = read_archive_meta(path)
+    return (m.total_size, m.parity_num, m.native_num, m.total_mat, m.w,
+            m.crcs)
 
 
 def read_metadata(path: str) -> tuple[int, int, int, np.ndarray | None]:
@@ -186,18 +304,68 @@ def append_checksums(path: str, crcs: dict[int, int]) -> None:
             fp.write(f"# crc32 {i} {crcs[i] & 0xFFFFFFFF:08x}\n")
 
 
+def rewrite_metadata_lines(
+    path: str,
+    *,
+    total_size: int | None = None,
+    crcs: dict[int, int] | None = None,
+    bump_generation: bool = False,
+) -> int:
+    """Crash-safe in-place .METADATA mutation: write-temp + fsync + atomic
+    rename (docs/UPDATE.md).  Optionally replaces the totalSize line
+    (append grows it), replaces ALL ``# crc32`` lines with ``crcs``
+    (None keeps the existing lines), and bumps the monotonic
+    ``# generation`` counter (update/append commits).  Every other line —
+    the base format, ``# gfwidth``, ``# layout`` — is preserved
+    byte-for-byte.  Returns the generation recorded.
+
+    The fsync-before-rename is the fix for the wholesale-rewrite torn-
+    metadata window: a crash between write and rename leaves either the
+    complete old file or the complete new one, never a torn .METADATA —
+    and decode/scrub never read the ``.tmp`` name, so a stale temp from
+    a crashed rewrite is inert until the next rewrite replaces it.
+    """
+    with open(path) as fp:
+        lines = fp.readlines()
+    generation = _parse_generation("".join(lines))
+    if bump_generation:
+        generation += 1
+    kept = []
+    for ln in lines:
+        head = ln.split()[:2]
+        if head == ["#", "generation"]:
+            continue
+        if crcs is not None and head == ["#", "crc32"]:
+            continue
+        kept.append(ln)
+    if total_size is not None:
+        kept[0] = f"{total_size}\n"
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fp:
+        fp.writelines(kept)
+        if crcs is not None:
+            for i in sorted(crcs):
+                fp.write(f"# crc32 {i} {crcs[i] & 0xFFFFFFFF:08x}\n")
+        if generation:
+            fp.write(f"# generation {generation}\n")
+        fp.flush()
+        os.fsync(fp.fileno())
+    os.replace(tmp, path)
+    # Make the rename itself durable: the caller's next step may unlink
+    # the undo journal, and a power cut must never persist that unlink
+    # while losing this rename (the torn state recovery couldn't see).
+    fsync_dir(path)
+    return generation
+
+
 def rewrite_checksums(path: str, crcs: dict[int, int]) -> None:
     """Replace ALL ``# crc32`` lines of a metadata file with ``crcs``
     (repair refreshes rebuilt chunks' CRCs; other extension lines and the
-    base format are preserved byte-for-byte)."""
-    with open(path) as fp:
-        lines = fp.readlines()
-    kept = [ln for ln in lines if ln.split()[:2] != ["#", "crc32"]]
-    with open(path + ".tmp", "w") as fp:
-        fp.writelines(kept)
-        for i in sorted(crcs):
-            fp.write(f"# crc32 {i} {crcs[i] & 0xFFFFFFFF:08x}\n")
-    os.replace(path + ".tmp", path)
+    base format are preserved byte-for-byte).  Routes through the
+    crash-safe :func:`rewrite_metadata_lines` path (fsync + atomic
+    rename; generation preserved, not bumped — repair restores state, it
+    does not advance it)."""
+    rewrite_metadata_lines(path, crcs=crcs)
 
 
 def _parse_checksums(text: str) -> dict[int, int]:

@@ -322,6 +322,135 @@ def test_strategies_agree_sampled_gf16():
             )
 
 
+# -- delta-parity linearity (ISSUE 10: rs update / rs append) -----------------
+#
+# The update subsystem's entire correctness argument is GF linearity:
+# E·(a ⊕ b) == E·a ⊕ E·b, hence parity' == parity ⊕ E·Δ for Δ = new ⊕
+# old.  These seeded properties pin the identity across the strategy zoo
+# and both symbol widths, then at the file level across segment
+# boundaries and the final ragged column (docs/UPDATE.md).
+
+
+def test_encode_linearity_across_strategies():
+    """E·(a⊕b) == E·a ⊕ E·b for every host-safe strategy × w=8/16."""
+    from gpu_rscode_tpu import native
+    from gpu_rscode_tpu.ops.gemm import gf_matmul
+
+    rng = np.random.default_rng(20260804)
+    for w in (8, 16):
+        dtype = np.uint8 if w == 8 else np.uint16
+        hi = 1 << w
+        for _ in range(4):
+            p = int(rng.integers(1, 5))
+            k = int(rng.integers(1, 8))
+            m = int(rng.integers(1, 300))
+            E = rng.integers(0, hi, size=(p, k)).astype(dtype)
+            a = rng.integers(0, hi, size=(k, m)).astype(dtype)
+            b = rng.integers(0, hi, size=(k, m)).astype(dtype)
+            for strategy in ("table", "bitplane", "pallas"):
+                lhs = np.asarray(gf_matmul(E, a ^ b, w=w, strategy=strategy))
+                rhs = np.asarray(
+                    gf_matmul(E, a, w=w, strategy=strategy)
+                ) ^ np.asarray(gf_matmul(E, b, w=w, strategy=strategy))
+                np.testing.assert_array_equal(
+                    lhs, rhs, err_msg=f"{strategy} w={w}"
+                )
+            if w == 8:
+                np.testing.assert_array_equal(
+                    native.gemm(E, a ^ b),
+                    native.gemm(E, a) ^ native.gemm(E, b),
+                )
+
+
+def test_delta_parity_identity_across_strategies():
+    """parity' == parity ⊕ E·Δ: patching a random sub-range of the
+    natives moves the parity by exactly the delta GEMM, for every
+    strategy × width — including Δ confined to a few columns (the
+    partial-stripe case rs update dispatches)."""
+    from gpu_rscode_tpu.ops.gemm import gf_matmul
+
+    rng = np.random.default_rng(108)
+    for w in (8, 16):
+        dtype = np.uint8 if w == 8 else np.uint16
+        hi = 1 << w
+        for _ in range(4):
+            k = int(rng.integers(2, 7))
+            p = int(rng.integers(1, 4))
+            m = int(rng.integers(8, 260))
+            codec = RSCodec(k, p, w=w)
+            E = codec.parity_block
+            old = rng.integers(0, hi, size=(k, m)).astype(dtype)
+            new = old.copy()
+            c0 = int(rng.integers(0, m))
+            c1 = int(rng.integers(c0 + 1, m + 1))
+            r = int(rng.integers(0, k))
+            new[r, c0:c1] = rng.integers(0, hi, size=c1 - c0).astype(dtype)
+            parity_old = np.asarray(codec.encode(old))
+            parity_new = np.asarray(codec.encode(new))
+            delta = old ^ new
+            for strategy in ("table", "bitplane", "pallas"):
+                pd = np.asarray(gf_matmul(E, delta, w=w, strategy=strategy))
+                np.testing.assert_array_equal(
+                    parity_old ^ pd, parity_new,
+                    err_msg=f"{strategy} w={w} cols[{c0}:{c1}]",
+                )
+            # The column-sliced dispatch rs update actually issues: the
+            # delta GEMM over JUST the touched columns patches exactly
+            # those parity columns.
+            pd_cols = np.asarray(
+                gf_matmul(E, delta[:, c0:c1], w=w, strategy="table")
+            )
+            np.testing.assert_array_equal(
+                parity_old[:, c0:c1] ^ pd_cols, parity_new[:, c0:c1]
+            )
+
+
+def test_update_file_matches_reencode_across_boundaries(tmp_path):
+    """File-level delta updates spanning segment-block boundaries, chunk
+    (row) boundaries and the final ragged column leave every chunk file
+    byte-identical to a from-scratch re-encode of the edited bytes —
+    both layouts, both widths."""
+    from gpu_rscode_tpu import api
+    from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+    rng = np.random.default_rng(20260810)
+    for layout in ("row", "interleaved"):
+        for w in (8, 16):
+            k, p, size = 4, 2, 30011  # odd size: ragged tail column
+            path = str(tmp_path / f"u_{layout}_{w}.bin")
+            data = rng.integers(0, 256, size=size, dtype=np.uint8)
+            open(path, "wb").write(data.tobytes())
+            api.encode_file(
+                path, k, p, checksums=True, w=w, layout=layout,
+                segment_bytes=4096,
+            )
+            mirror = bytearray(data.tobytes())
+            chunk = -(-size // k)
+            edits = [
+                (0, 3),                      # head
+                (size - 5, 5),               # ragged tail column
+                (chunk - 2, 4),              # spans the row-0/row-1 seam
+                (4096 * 2 - 3, 4099),        # spans segment blocks
+            ]
+            for at, ln in edits:
+                delta = rng.integers(0, 256, size=ln, dtype=np.uint8)
+                api.update_file(path, at, delta.tobytes(),
+                                segment_bytes=4096)
+                mirror[at : at + ln] = delta.tobytes()
+            twin = str(tmp_path / f"t_{layout}_{w}.bin")
+            open(twin, "wb").write(bytes(mirror))
+            api.encode_file(
+                twin, k, p, checksums=True, w=w, layout=layout,
+                segment_bytes=4096,
+            )
+            for c in range(k + p):
+                np.testing.assert_array_equal(
+                    np.fromfile(chunk_file_name(path, c), dtype=np.uint8),
+                    np.fromfile(chunk_file_name(twin, c), dtype=np.uint8),
+                    err_msg=f"{layout} w={w} chunk {c}",
+                )
+
+
 def test_seeded_single_chunk_bitrot_never_silently_wrong(tmp_path):
     """The resilience invariant: random bitrot in one random chunk of a
     checksummed archive is always either CRC-caught (scan lists it

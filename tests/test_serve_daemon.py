@@ -496,3 +496,81 @@ def test_maybe_start_from_env_reuses_one_server(monkeypatch):
         obs_serve.stop(nxt)
         metrics.force_enable(False)
         metrics.REGISTRY.reset()
+
+
+# ----- partial-stripe write traffic: /update and /append (docs/UPDATE.md) ----
+
+
+def test_serve_update_append_roundtrip(daemon):
+    """Encode (interleaved), delta-update a range, append a tail — the
+    decoded body is the tracked logical bytes, and the op summaries carry
+    the engine's generation counter."""
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, size=60000, dtype=np.uint8).tobytes()
+    st, _ = _post(daemon.port,
+                  "/encode?name=up.bin&k=4&n=6&layout=interleaved", data)
+    assert st == 200
+    delta = rng.integers(0, 256, size=2500, dtype=np.uint8).tobytes()
+    st, body = _post(daemon.port, "/update?name=up.bin&at=12000", delta)
+    assert st == 200, body
+    res = json.loads(body)
+    assert res["ok"] and res["update"]["op"] == "update"
+    assert res["update"]["generation"] == 1
+    tail = rng.integers(0, 256, size=4000, dtype=np.uint8).tobytes()
+    st, body = _post(daemon.port, "/append?name=up.bin", tail)
+    assert st == 200, body
+    res = json.loads(body)
+    assert res["update"]["total_size"] == 64000
+    st, body = _post(daemon.port, "/decode?name=up.bin")
+    assert st == 200
+    mirror = bytearray(data)
+    mirror[12000:14500] = delta
+    mirror += tail
+    assert body == bytes(mirror)
+
+
+def test_serve_update_error_paths(daemon):
+    # unknown archive -> 404 before anything queues
+    st, _ = _post(daemon.port, "/update?name=ghost.bin&at=0", b"x")
+    assert st == 404
+    st, _ = _post(daemon.port, "/append?name=ghost.bin", b"x")
+    assert st == 404
+    rng = np.random.default_rng(32)
+    data = rng.integers(0, 256, size=1000, dtype=np.uint8).tobytes()
+    st, _ = _post(daemon.port, "/encode?name=e.bin&k=2&n=3", data)
+    assert st == 200
+    # missing/garbage at= -> 400
+    st, _ = _post(daemon.port, "/update?name=e.bin", b"x")
+    assert st == 400
+    st, _ = _post(daemon.port, "/update?name=e.bin&at=nope", b"x")
+    assert st == 400
+    # empty payload -> 400
+    st, _ = _post(daemon.port, "/append?name=e.bin", b"")
+    assert st == 400
+    # out-of-range update -> bounded 500 naming the cause, queue moves on
+    st, body = _post(daemon.port, "/update?name=e.bin&at=999", b"xyz")
+    assert st == 500 and b"rs append" in body
+    st, _ = _post(daemon.port, "/scrub?name=e.bin")
+    assert st == 200  # daemon not wedged
+
+
+def test_serve_encode_rejects_bad_layout(daemon):
+    st, body = _post(daemon.port,
+                     "/encode?name=l.bin&k=2&n=3&layout=spiral", b"abc")
+    assert st == 400 and b"layout" in body
+
+
+def test_loadgen_update_schedule_mix():
+    """--update-frac draws update arrivals (seeded, replayable) and the
+    three op kinds partition the stream."""
+    from gpu_rscode_tpu.serve.loadgen import _schedule
+
+    plan = _schedule(60.0, 20.0, [("a", 1.0)], decode_frac=0.3,
+                     seed=5, update_frac=0.4)
+    ops = {op for _, _, op in plan}
+    assert ops == {"encode", "decode", "update"}
+    again = _schedule(60.0, 20.0, [("a", 1.0)], decode_frac=0.3,
+                      seed=5, update_frac=0.4)
+    assert plan == again
+    frac = sum(1 for _, _, op in plan if op == "update") / len(plan)
+    assert 0.3 < frac < 0.5

@@ -1,0 +1,491 @@
+"""Delta-parity updates and append-mode encoding (ISSUE 10,
+docs/UPDATE.md): seekable CRC math, the undo journal, the patch engine
+across both chunk layouts and widths, torn-op recovery, the interleaved
+encode/decode path, the ordered pwrite lane, and the CLI surface."""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api, cli
+from gpu_rscode_tpu.update import crc as ucrc
+from gpu_rscode_tpu.update import journal as ujournal
+from gpu_rscode_tpu.update import layout as ulayout
+from gpu_rscode_tpu.update.engine import SimulatedCrash, UpdateError
+from gpu_rscode_tpu.utils.fileformat import (
+    chunk_file_name,
+    metadata_file_name,
+    read_archive_meta,
+    rewrite_metadata_lines,
+    write_metadata,
+)
+
+SEG = 4096  # force multi-segment streaming for small test files
+
+
+def _encode(tmp_path, name, data, k=4, p=2, w=8, layout="row",
+            checksums=True):
+    path = str(tmp_path / name)
+    with open(path, "wb") as fp:
+        fp.write(data)
+    api.encode_file(path, k, p, checksums=checksums, w=w, layout=layout,
+                    segment_bytes=SEG)
+    return path
+
+
+def _chunks(path, n):
+    return [open(chunk_file_name(path, c), "rb").read() for c in range(n)]
+
+
+def _decode_bytes(path):
+    out = api.auto_decode_file(path, path + ".dec", segment_bytes=SEG)
+    with open(out, "rb") as fp:
+        return fp.read()
+
+
+# ----- seekable CRC math -----------------------------------------------------
+
+
+def test_crc32_combine_matches_zlib():
+    rng = np.random.default_rng(1)
+    for _ in range(16):
+        a = rng.integers(0, 256, size=int(rng.integers(0, 5000)),
+                         dtype=np.uint8).tobytes()
+        b = rng.integers(0, 256, size=int(rng.integers(0, 5000)),
+                         dtype=np.uint8).tobytes()
+        assert ucrc.crc32_combine(
+            zlib.crc32(a), zlib.crc32(b), len(b)
+        ) == zlib.crc32(a + b)
+
+
+def test_crc32_zeros_matches_zlib():
+    for n in (0, 1, 2, 3, 63, 64, 65, 4096, 123457):
+        assert ucrc.crc32_zeros(n) == zlib.crc32(b"\x00" * n), n
+
+
+def test_crc32_patch_matches_full_rehash():
+    rng = np.random.default_rng(2)
+    for _ in range(16):
+        n = int(rng.integers(1, 8192))
+        old = rng.integers(0, 256, size=n, dtype=np.uint8)
+        off = int(rng.integers(0, n))
+        ln = int(rng.integers(1, n - off + 1))
+        new_mid = rng.integers(0, 256, size=ln, dtype=np.uint8)
+        new = old.copy()
+        new[off : off + ln] = new_mid
+        delta = (old[off : off + ln] ^ new_mid).tobytes()
+        assert ucrc.crc32_patch(
+            zlib.crc32(old.tobytes()), n, off, delta
+        ) == zlib.crc32(new.tobytes())
+
+
+def test_crc32_append_matches_zlib():
+    assert ucrc.crc32_append(zlib.crc32(b"abc"), b"def") == \
+        zlib.crc32(b"abcdef")
+
+
+# ----- interleave geometry ---------------------------------------------------
+
+
+def test_interleave_roundtrip_and_symbol_mapping():
+    rng = np.random.default_rng(3)
+    for k, sym, cols in [(4, 1, 7), (3, 2, 5), (1, 1, 9), (6, 2, 1)]:
+        flat = rng.integers(0, 256, size=k * cols * sym, dtype=np.uint8)
+        rows = ulayout.interleave(flat, k, sym)
+        assert rows.shape == (k, cols * sym)
+        np.testing.assert_array_equal(ulayout.deinterleave(rows, sym), flat)
+        # symbol s -> row s % k, col s // k
+        for s in range(k * cols):
+            np.testing.assert_array_equal(
+                rows[s % k, (s // k) * sym : (s // k) * sym + sym],
+                flat[s * sym : (s + 1) * sym],
+            )
+
+
+def test_touched_windows_row_layout():
+    # single row, sym alignment
+    assert ulayout.touched_windows("row", 10, 4, 4, 2, 100) == [(10, 14)]
+    assert ulayout.touched_windows("row", 11, 1, 4, 2, 100) == [(10, 12)]
+    # adjacent rows, disjoint column footprints -> two windows
+    assert ulayout.touched_windows("row", 90, 20, 4, 1, 100) == \
+        [(0, 10), (90, 100)]
+    # three rows -> full chunk
+    assert ulayout.touched_windows("row", 50, 250, 4, 1, 100) == [(0, 100)]
+
+
+def test_touched_windows_interleaved():
+    # k=4, sym=1: byte 17 lives in column 4
+    assert ulayout.touched_windows("interleaved", 17, 1, 4, 1, 100) == \
+        [(4, 5)]
+    assert ulayout.touched_windows("interleaved", 0, 9, 4, 1, 100) == \
+        [(0, 3)]
+
+
+# ----- the patch engine ------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["row", "interleaved"])
+@pytest.mark.parametrize("w", [8, 16])
+def test_update_roundtrip_and_summary(tmp_path, layout, w):
+    rng = np.random.default_rng(4)
+    data = rng.integers(0, 256, size=20000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, f"u_{layout}_{w}.bin", data, layout=layout,
+                   w=w)
+    delta = rng.integers(0, 256, size=300, dtype=np.uint8).tobytes()
+    res = api.update_file(path, 7777, delta, segment_bytes=SEG)
+    assert res["op"] == "update" and res["bytes"] == 300
+    assert res["generation"] == 1 and res["segments"] >= 1
+    mirror = bytearray(data)
+    mirror[7777:8077] = delta
+    assert _decode_bytes(path) == bytes(mirror)
+    rep = api.scan_file(path, segment_bytes=SEG)
+    assert rep["decodable"] is True and not rep["corrupt"]
+    assert rep["generation"] == 1 and rep["layout"] == layout
+    assert rep["pending_journal"] is False
+
+
+def test_update_without_checksums_keeps_metadata_crc_free(tmp_path):
+    rng = np.random.default_rng(5)
+    data = rng.integers(0, 256, size=9000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "nc.bin", data, checksums=False)
+    api.update_file(path, 100, b"\x42" * 50, segment_bytes=SEG)
+    meta = read_archive_meta(metadata_file_name(path))
+    assert meta.crcs == {} and meta.generation == 1
+    mirror = bytearray(data)
+    mirror[100:150] = b"\x42" * 50
+    assert _decode_bytes(path) == bytes(mirror)
+
+
+def test_update_range_and_payload_errors(tmp_path):
+    rng = np.random.default_rng(6)
+    data = rng.integers(0, 256, size=1000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "err.bin", data)
+    with pytest.raises(UpdateError, match="rs append"):
+        api.update_file(path, 990, b"x" * 20, segment_bytes=SEG)
+    with pytest.raises(ValueError, match="exactly one"):
+        api.update_file(path, 0, segment_bytes=SEG)
+    # zero-length payload is a clean no-op, not an error
+    res = api.update_file(path, 0, b"", segment_bytes=SEG)
+    assert res["segments"] == 0 and res["generation"] == 0
+
+
+def test_update_missing_chunk_demands_repair(tmp_path):
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=5000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "mc.bin", data)
+    os.unlink(chunk_file_name(path, 5))  # a parity chunk — always opened
+    with pytest.raises(UpdateError, match="repair"):
+        api.update_file(path, 0, b"hi", segment_bytes=SEG)
+    # repair heals it; the update then lands
+    assert api.repair_file(path, segment_bytes=SEG) == [5]
+    api.update_file(path, 0, b"hi", segment_bytes=SEG)
+    mirror = bytearray(data)
+    mirror[0:2] = b"hi"
+    assert _decode_bytes(path) == bytes(mirror)
+
+
+def test_update_rejects_foreign_nonsystematic_metadata(tmp_path):
+    rng = np.random.default_rng(8)
+    data = rng.integers(0, 256, size=256, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "foreign.bin", data, k=2, p=1)
+    # overwrite the metadata with a non-systematic total matrix
+    mat = np.array([[2, 3], [1, 1], [1, 2]], dtype=np.uint8)
+    write_metadata(metadata_file_name(path), 256, 1, 2, mat)
+    with pytest.raises(UpdateError, match="systematic"):
+        api.update_file(path, 0, b"zz", segment_bytes=SEG)
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_append_interleaved_growth_matches_reencode(tmp_path, w):
+    rng = np.random.default_rng(9)
+    data = rng.integers(0, 256, size=10007, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, f"ap_{w}.bin", data, layout="interleaved",
+                   w=w)
+    mirror = bytearray(data)
+    for ln in (1, 7, 4096, 8192):  # partial column + multi-block growth
+        tail = rng.integers(0, 256, size=ln, dtype=np.uint8).tobytes()
+        res = api.append_file(path, tail, segment_bytes=SEG)
+        mirror += tail
+        assert res["total_size"] == len(mirror)
+    assert _decode_bytes(path) == bytes(mirror)
+    twin = _encode(tmp_path, f"tw_{w}.bin", bytes(mirror),
+                   layout="interleaved", w=w)
+    assert _chunks(path, 6) == _chunks(twin, 6)
+    ma = read_archive_meta(metadata_file_name(path))
+    mb = read_archive_meta(metadata_file_name(twin))
+    assert ma.crcs == mb.crcs
+
+
+def test_append_row_layout_slack_bounded(tmp_path):
+    rng = np.random.default_rng(10)
+    data = rng.integers(0, 256, size=10, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "slack.bin", data, k=4, p=1)
+    # chunk = ceil(10/4) = 3 -> 2 bytes of slack
+    api.append_file(path, b"XY", segment_bytes=SEG)
+    assert _decode_bytes(path) == data + b"XY"
+    with pytest.raises(UpdateError, match="slack"):
+        api.append_file(path, b"Z", segment_bytes=SEG)
+
+
+def test_append_only_touches_tail_columns(tmp_path):
+    """The append-mode contract: cold column bytes of every chunk are
+    untouched — only the tail block past the pre-append column changes."""
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, size=40000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "cold.bin", data, layout="interleaved")
+    before = _chunks(path, 6)
+    old_len = len(before[0])
+    meta = read_archive_meta(metadata_file_name(path))
+    tail_lo = (meta.total_size // (4 * 1))  # k=4, sym=1: partial col start
+    api.append_file(path, b"\xEE" * 5000, segment_bytes=SEG)
+    after = _chunks(path, 6)
+    for c in range(6):
+        assert after[c][: tail_lo] == before[c][: tail_lo], c
+        assert len(after[c]) > old_len
+
+
+# ----- torn ops, journal, recovery -------------------------------------------
+
+
+@pytest.mark.parametrize("stage",
+                         ["after_journal", "mid_patch", "before_commit"])
+def test_torn_update_rolls_back_byte_exact(tmp_path, monkeypatch, stage):
+    rng = np.random.default_rng(12)
+    data = rng.integers(0, 256, size=20000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, f"torn_{stage}.bin", data,
+                   layout="interleaved")
+    pre = _chunks(path, 6) + [open(metadata_file_name(path), "rb").read()]
+    monkeypatch.setenv("RS_UPDATE_CRASH", stage)
+    with pytest.raises(SimulatedCrash):
+        api.update_file(path, 5000, b"\xAA" * 3000, segment_bytes=SEG)
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    assert os.path.exists(ujournal.journal_path(path))
+    assert api.scan_file(path, segment_bytes=SEG)["pending_journal"]
+    assert api.recover_archive(path) == "rolled_back"
+    post = _chunks(path, 6) + [open(metadata_file_name(path), "rb").read()]
+    assert post == pre
+    assert _decode_bytes(path) == data
+
+
+def test_torn_append_rolls_back_extension(tmp_path, monkeypatch):
+    rng = np.random.default_rng(13)
+    data = rng.integers(0, 256, size=8000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "tornap.bin", data, layout="interleaved")
+    pre_lens = [len(c) for c in _chunks(path, 6)]
+    monkeypatch.setenv("RS_UPDATE_CRASH", "before_commit")
+    with pytest.raises(SimulatedCrash):
+        api.append_file(path, b"\xBB" * 6000, segment_bytes=SEG)
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    # the torn tail is on disk (chunks over-long) until recovery truncates
+    assert any(
+        len(open(chunk_file_name(path, c), "rb").read()) > pre_lens[c]
+        for c in range(6)
+    )
+    # the NEXT append auto-recovers at open and then lands cleanly
+    res = api.append_file(path, b"ok", segment_bytes=SEG)
+    assert res["recovered"] == "rolled_back"
+    assert _decode_bytes(path) == data + b"ok"
+
+
+def test_in_process_failure_rolls_back_without_journal_residue(tmp_path,
+                                                               monkeypatch):
+    from gpu_rscode_tpu.resilience import faults
+
+    rng = np.random.default_rng(14)
+    data = rng.integers(0, 256, size=12000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "ipr.bin", data)
+    pre = _chunks(path, 6)
+    monkeypatch.setenv("RS_RETRY_ATTEMPTS", "1")
+    plan = faults.parse_plan("write:torn@after=1", seed=1)
+    with faults.activate(plan):
+        with pytest.raises(OSError):
+            api.update_file(path, 3000, b"\xCC" * 2000, segment_bytes=SEG)
+    assert not os.path.exists(ujournal.journal_path(path))
+    assert _chunks(path, 6) == pre
+    assert _decode_bytes(path) == data
+
+
+def test_stale_and_invalid_journals_discarded(tmp_path):
+    rng = np.random.default_rng(15)
+    data = rng.integers(0, 256, size=4000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "stale.bin", data)
+    # a journal whose generation predates the live metadata == committed
+    jr = ujournal.Journal(path, generation=0, op="update", chunk_len={})
+    jr.sync()
+    jr._fp.close()
+    rewrite_metadata_lines(metadata_file_name(path), bump_generation=True)
+    assert api.recover_archive(path) == "stale_discarded"
+    # garbage journal: discard, never crash
+    with open(ujournal.journal_path(path), "wb") as fp:
+        fp.write(b"not a journal\n\x00\x01")
+    assert api.recover_archive(path) == "invalid_discarded"
+    assert api.recover_archive(path) == "none"
+
+
+def test_generation_is_monotonic_and_repair_preserves_it(tmp_path):
+    rng = np.random.default_rng(16)
+    data = rng.integers(0, 256, size=6000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "gen.bin", data, layout="interleaved")
+    for g in (1, 2, 3):
+        res = api.update_file(path, 10, bytes([g]) * 10, segment_bytes=SEG)
+        assert res["generation"] == g
+    os.unlink(chunk_file_name(path, 2))
+    api.repair_file(path, segment_bytes=SEG)  # rewrites CRC lines
+    assert read_archive_meta(metadata_file_name(path)).generation == 3
+
+
+# ----- interleaved layout through the wider stack ----------------------------
+
+
+def test_interleaved_base_metadata_declares_layout(tmp_path):
+    rng = np.random.default_rng(17)
+    data = rng.integers(0, 256, size=5000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "decl.bin", data, layout="interleaved")
+    meta = read_archive_meta(metadata_file_name(path))
+    assert meta.layout == "interleaved" and meta.generation == 0
+    # row encodes keep the reference-compatible metadata (no layout line)
+    path2 = _encode(tmp_path, "decl2.bin", data)
+    with open(metadata_file_name(path2)) as fp:
+        assert "layout" not in fp.read()
+
+
+def test_interleaved_decode_fleet_and_repair(tmp_path):
+    rng = np.random.default_rng(18)
+    files, blobs = [], {}
+    for i in range(3):
+        data = rng.integers(0, 256, size=7000 + i, dtype=np.uint8).tobytes()
+        path = _encode(tmp_path, f"fleet{i}.bin", data,
+                       layout="interleaved")
+        os.unlink(chunk_file_name(path, i % 4))
+        files.append(path)
+        blobs[path] = data
+    outs = api.decode_fleet(
+        files, {f: f + ".out" for f in files}, segment_bytes=SEG
+    )
+    for f in files:
+        assert open(outs[f], "rb").read() == blobs[f]
+    for i, f in enumerate(files):
+        assert api.repair_file(f, segment_bytes=SEG) == [i % 4]
+
+
+def test_interleaved_locate_decode_recovers_silent_bitrot(tmp_path):
+    """The error-locating plane is layout-agnostic in the math and
+    layout-aware in the output mapping: CRC-less bitrot on an
+    interleaved archive locates, patches and decodes bit-exact."""
+    rng = np.random.default_rng(19)
+    data = rng.integers(0, 256, size=9000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "loc.bin", data, layout="interleaved",
+                   checksums=False, p=2)
+    vpath = chunk_file_name(path, 1)
+    buf = bytearray(open(vpath, "rb").read())
+    buf[100] ^= 0x40
+    open(vpath, "wb").write(bytes(buf))
+    out = api.locate_decode_file(path, path + ".ld", segment_bytes=SEG)
+    assert open(out, "rb").read() == data
+
+
+def test_interleaved_rejects_mesh_and_bad_layout(tmp_path):
+    rng = np.random.default_rng(20)
+    data = rng.integers(0, 256, size=100, dtype=np.uint8).tobytes()
+    path = str(tmp_path / "rej.bin")
+    open(path, "wb").write(data)
+    with pytest.raises(ValueError, match="unknown chunk layout"):
+        api.encode_file(path, 2, 1, layout="diagonal")
+
+
+# ----- ordered pwrite lane ---------------------------------------------------
+
+
+def test_submit_pwrite_orders_and_counts(tmp_path):
+    from gpu_rscode_tpu.parallel.io_executor import DrainExecutor
+
+    path = str(tmp_path / "lane.bin")
+    with open(path, "wb") as fp:
+        fp.truncate(16)
+    with open(path, "r+b") as fp, DrainExecutor(
+        ordered=True, name="rs-io-patch"
+    ) as lane:
+        lane.submit_pwrite(fp.fileno(), b"AAAA", 0)
+        lane.submit_pwrite(fp.fileno(), b"BB", 2)   # later wins: ordered
+        lane.submit_pwrite(fp.fileno(), b"CCCC", 12)
+        lane.flush()
+    assert open(path, "rb").read() == b"AABB\x00" * 1 + b"\x00" * 7 + b"CCCC"
+
+
+# ----- CLI surface -----------------------------------------------------------
+
+
+def test_cli_update_append_roundtrip(tmp_path, capsys):
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, size=15000, dtype=np.uint8).tobytes()
+    path = str(tmp_path / "cli.bin")
+    open(path, "wb").write(data)
+    assert cli.main(["-k", "4", "-n", "6", "--checksum", "--layout",
+                     "interleaved", "--quiet", "-e", path]) == 0
+    delta_path = str(tmp_path / "delta.bin")
+    open(delta_path, "wb").write(b"\x7F" * 123)
+    assert cli.main(["update", path, "--at", "5000", "--in", delta_path,
+                     "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["op"] == "update" and summary["generation"] == 1
+    tail_path = str(tmp_path / "tail.bin")
+    open(tail_path, "wb").write(b"\x11" * 777)
+    assert cli.main(["append", path, "--in", tail_path, "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["total_size"] == 15777
+    mirror = bytearray(data)
+    mirror[5000:5123] = b"\x7F" * 123
+    mirror += b"\x11" * 777
+    assert _decode_bytes(path) == bytes(mirror)
+
+
+def test_cli_update_usage_errors(tmp_path, capsys):
+    path = str(tmp_path / "u.bin")
+    open(path, "wb").write(b"x" * 100)
+    assert cli.main(["-k", "2", "-n", "3", "--quiet", "-e", path]) == 0
+    assert cli.main(["update", path, "--in", path]) == 2      # no --at
+    assert cli.main(["update", path, "--at", "0"]) == 2       # no --in
+    assert cli.main(["append", path]) == 2                    # no --in
+    capsys.readouterr()
+    # --recover on a clean archive reports none
+    assert cli.main(["update", path, "--recover"]) == 0
+    assert json.loads(capsys.readouterr().out)["recovered"] == "none"
+
+
+def test_cli_layout_flag_validation(tmp_path, capsys):
+    path = str(tmp_path / "v.bin")
+    open(path, "wb").write(b"x" * 10)
+    assert cli.main(["-k", "2", "-n", "3", "--layout", "spiral",
+                     "--quiet", "-e", path]) == 2
+    assert cli.main(["-d", "--auto", "--layout", "interleaved",
+                     "-i", path]) == 2  # decode-only rejection
+    capsys.readouterr()
+
+
+# ----- A/B bench capture contract --------------------------------------------
+
+
+def test_update_bench_ab_capture_schema(tmp_path):
+    """Tiny in-process run of tools/update_bench.py --ab: capture_header
+    first line, one row per layout, speedup recorded (the CI update-smoke
+    job validates the same schema)."""
+    from gpu_rscode_tpu.tools.update_bench import main as bench_main
+
+    capture = str(tmp_path / "cap.jsonl")
+    rc = bench_main([
+        "--ab", "--size-mb", "1", "--edit-kb", "4", "--trials", "1",
+        "--k", "4", "--p", "2", "--dir", str(tmp_path / "work"),
+        "--capture", capture, "--json",
+    ])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(capture)]
+    assert rows[0]["kind"] == "capture_header"
+    assert rows[0]["tool"] == "update_bench"
+    ab = [r for r in rows if r["kind"] == "update_ab"]
+    assert {r["layout"] for r in ab} == {"row", "interleaved"}
+    for r in ab:
+        assert r["update_wall_s"] > 0 and r["reencode_wall_s"] > 0
+        assert r["speedup"] is not None and r["segments_touched"] >= 1
