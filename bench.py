@@ -530,12 +530,31 @@ def main() -> None:
         ]
         return jax.numpy.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
 
+    from gpu_rscode_tpu.ops.xor_gemm import gf_matmul_xor
+
+    def run_xor():
+        # The XOR-lowered bitsliced strategy (docs/XOR.md): same segment
+        # discipline as the other XLA paths (the packed planes expand in
+        # memory 1x, but the staged pipeline still prefers bounded
+        # dispatch extents).
+        outs = [
+            gf_matmul_xor(A, Bd[:, off : off + seg], 8)
+            for off in range(0, m, seg)
+        ]
+        return jax.numpy.concatenate(outs, axis=1) if len(outs) > 1 else outs[0]
+
     small = {
         "pallas": lambda: gf_matmul_pallas(Ad, Bd_small),
         "bitplane": lambda: gf_matmul_jit(Ad, Bd_small, strategy="bitplane"),
         "table": lambda: gf_matmul_jit(Ad, Bd_small, strategy="table"),
+        "xor": lambda: gf_matmul_xor(A, Bd_small, 8),
     }
-    candidates = [("pallas", run_pallas), ("bitplane", run_bitplane), ("table", run_table)]
+    candidates = [
+        ("pallas", run_pallas),
+        ("xor", run_xor),
+        ("bitplane", run_bitplane),
+        ("table", run_table),
+    ]
     import os
 
     # Hardware CHILD of the retry loop: it runs under a hard subprocess

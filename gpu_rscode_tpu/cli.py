@@ -12,9 +12,11 @@ ordering rule.
 
 Extensions (flagged long options, no reference equivalent):
 ``--generator {vandermonde,cauchy}``,
-``--strategy {auto,bitplane,table,pallas,cpu}`` (default auto: the fused
-pallas kernel on TPU hardware, meshes included — every fused dispatch is
-guarded with a bitplane fallback — bitplane elsewhere), ``--devices N`` /
+``--strategy {auto,bitplane,table,pallas,xor,cpu}`` (default auto,
+resolved per backend by the strategy autotuner: the fused pallas kernel
+on TPU hardware, meshes included — every fused dispatch is guarded with
+a bitplane fallback — bitplane elsewhere; RS_STRATEGY_AUTOTUNE=measure
+lets xor/native compete on real timings), ``--devices N`` /
 ``--stripe S``
 (mesh sharding), ``--quiet`` (suppress the timing report),
 ``--profile-dir DIR`` (jax.profiler trace output).
@@ -44,9 +46,11 @@ Performance-tuning options:
          overridable via env RS_PALLAS_TILE
 [-s|-S]: pipeline depth (segments in flight, default 2)
 Extensions: [--generator vandermonde|cauchy]
-            [--strategy auto|bitplane|table|pallas|cpu]  (default auto:
-            pallas kernel on TPU incl. meshes, bitplane elsewhere;
-            cpu = host codec)
+            [--strategy auto|bitplane|table|pallas|xor|cpu]  (default
+            auto: resolved by the per-backend strategy autotuner —
+            pallas kernel on TPU incl. meshes, bitplane elsewhere,
+            RS_STRATEGY_AUTOTUNE=measure to compete on timings;
+            xor = bitsliced XOR lowering, docs/XOR.md; cpu = host codec)
             [--segment-bytes N] [--quiet] [--profile-dir DIR]
             [--devices N] [--stripe S]  (shard over a device mesh;
             S > 1 additionally shards the stripe/k axis)
@@ -454,7 +458,8 @@ def _update_main(argv: list[str], op: str) -> int:
                     help=("the replacement bytes" if op == "update"
                           else "the bytes to append"))
     ap.add_argument("--strategy", default="auto",
-                    choices=("auto", "bitplane", "table", "pallas", "cpu"))
+                    choices=("auto", "bitplane", "table", "pallas", "xor",
+                             "cpu"))
     ap.add_argument("--segment-bytes", type=int, default=None,
                     help="column block sizing (default 64 MiB of natives)")
     ap.add_argument("--json", action="store_true",
@@ -677,6 +682,18 @@ def main(argv: list[str] | None = None) -> int:
             trace_path = val
         elif f == "--faults":
             faults_spec = val
+
+    # One validation for every surface that takes --strategy (encode,
+    # decode, repair, batch fleets): the same enumerated usage error the
+    # update/append argparse choices produce, HERE as a usage failure
+    # instead of a mid-run codec ValueError after files were opened.
+    from .tune import VALID_STRATEGIES
+
+    if strategy not in VALID_STRATEGIES:
+        return _fail(
+            f"rs: unknown --strategy {strategy!r}; valid strategies are "
+            + "|".join(VALID_STRATEGIES)
+        )
 
     fault_plan = None
     if faults_spec is not None:

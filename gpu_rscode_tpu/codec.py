@@ -76,9 +76,13 @@ class RSCodec:
     ``generator``: "vandermonde" (reference-compatible: the exact matrix the
     reference generates and stores in .METADATA) or "cauchy" (any-k-subset
     decodable).  ``strategy``: GEMM strategy — "auto" (default at the file
-    layer) resolves to the fused Pallas kernel on a real TPU backend and
-    the XLA bitplane path elsewhere; explicit values: "pallas", "bitplane"
-    (MXU), "table" (VPU), "cpu" (native host codec).
+    layer) resolves through the per-backend autotuner (:mod:`.tune`:
+    pallas on real TPU hardware / bitplane elsewhere unless a measured
+    decision says otherwise; ``RS_STRATEGY_AUTOTUNE=measure`` lets
+    table/bitplane/pallas/xor/native compete on real timings); explicit
+    values: "pallas", "bitplane" (MXU), "table" (VPU), "xor"
+    (XOR-lowered bitsliced planes, docs/XOR.md), "cpu" (native host
+    codec).
     """
 
     def __init__(
@@ -93,14 +97,27 @@ class RSCodec:
     ):
         if native_num < 1 or parity_num < 0:
             raise ValueError(f"bad (k={native_num}, p={parity_num})")
+        from .tune import VALID_STRATEGIES, resolve_auto
+
+        if strategy not in VALID_STRATEGIES:
+            raise ValueError(
+                f"unknown strategy {strategy!r}: valid strategies are "
+                f"{', '.join(VALID_STRATEGIES)} — 'auto' resolves per "
+                "backend via the autotuner (docs/XOR.md, docs/PLAN.md)"
+            )
         if strategy == "auto":
-            # The fused kernel is the production default on real TPU
-            # hardware, mesh or not — the reference's multi-GPU mode runs
-            # its fast kernel unconditionally (decode.cu:335-378).  Both
-            # paths guard every fused dispatch: a Mosaic-class failure
-            # demotes to bitplane and recomputes the same bytes (see
-            # _matmul), so no kernel failure can corrupt output files.
-            strategy = "pallas" if _tpu_devices_present() else "bitplane"
+            # Resolved through the strategy autotuner (tune.py): the
+            # static prior keeps the old behaviour — fused kernel on
+            # real TPU hardware (the reference's multi-GPU mode runs its
+            # fast kernel unconditionally, decode.cu:335-378), bitplane
+            # elsewhere — and RS_STRATEGY_AUTOTUNE=measure lets xor and
+            # the native codec compete on real timings.  Every fused
+            # dispatch stays guarded: a Mosaic-class failure demotes to
+            # bitplane and recomputes the same bytes (see _matmul), so
+            # no kernel failure can corrupt output files.
+            strategy = resolve_auto(
+                native_num, parity_num, w, mesh=mesh, generator=generator
+            )
         self.gf = get_field(w)
         self.w = w
         self.native_num = native_num
@@ -118,6 +135,18 @@ class RSCodec:
             if mesh is not None:
                 raise ValueError(
                     "strategy='cpu' is host-only; it cannot run on a device mesh"
+                )
+        if strategy == "xor":
+            if w not in (8, 16):
+                raise ValueError(
+                    "strategy='xor' supports GF(2^8) and GF(2^16) only"
+                )
+            if mesh is not None:
+                raise ValueError(
+                    "strategy='xor' is single-device (its schedule is "
+                    "baked from concrete coefficients, which the jitted "
+                    "mesh collective cannot trace); use bitplane/table/"
+                    "pallas on a mesh"
                 )
         if mesh is not None:
             from .parallel.mesh import COLS, STRIPE
@@ -340,6 +369,14 @@ class RSCodec:
                     cap=plan_cap, cols=b_cols,
                     donate=staged and seg.host is not None,
                 )
+            if self.strategy == "xor":
+                # Value-dependent schedule: the coefficients must stay
+                # concrete, so this path never rides gf_matmul_jit
+                # (which would trace A).  Works under a caller's jit
+                # too — only the DATA may be traced.
+                from .ops.xor_gemm import gf_matmul_xor
+
+                return gf_matmul_xor(A, B, self.w)
             return gf_matmul_jit(A, B, w=self.w, strategy=self.strategy)
         from .parallel.sharded import put_sharded, sharded_gf_matmul
 

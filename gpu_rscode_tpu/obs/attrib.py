@@ -256,12 +256,14 @@ def sample_device_memory(force: bool = False) -> None:
 
 # -- attribution workload ----------------------------------------------------
 
-# Default strategy set for `rs analyze`: the two pure-JAX paths whose gap
-# the ROADMAP tracks, plus the native host codec ("native" is the analyze
+# Default strategy set for `rs analyze`: the pure-JAX paths whose gap
+# the ROADMAP tracks — including the XOR-lowered strategy built to close
+# it (docs/XOR.md) — plus the native host codec ("native" is the analyze
 # surface's name for the codec's strategy="cpu").
-DEFAULT_STRATEGIES = ("table", "bitplane", "native")
+DEFAULT_STRATEGIES = ("table", "bitplane", "xor", "native")
 
 _STRATEGY_ALIASES = {"native": "cpu"}
+_ANALYZABLE = ("table", "bitplane", "pallas", "xor", "cpu")
 
 
 def _counter_value(snapshot: dict, name: str, **labels) -> float:
@@ -603,7 +605,8 @@ def main(argv=None) -> int:
     ap.add_argument("--strategies",
                     default=",".join(DEFAULT_STRATEGIES),
                     help="comma-separated strategy list (default "
-                    "table,bitplane,native; 'native' is the host codec)")
+                    "table,bitplane,xor,native; 'native' is the host "
+                    "codec)")
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--p", type=int, default=2)
     ap.add_argument("--w", type=int, default=8, choices=(8, 16))
@@ -623,8 +626,7 @@ def main(argv=None) -> int:
         return int(e.code or 0)
     strategies = [s for s in args.strategies.split(",") if s]
     bad = [s for s in strategies
-           if _STRATEGY_ALIASES.get(s, s) not in
-           ("table", "bitplane", "pallas", "cpu")]
+           if _STRATEGY_ALIASES.get(s, s) not in _ANALYZABLE]
     if bad:
         print(f"rs analyze: unknown strategies {bad}", file=sys.stderr)
         return 2

@@ -21,6 +21,9 @@ Sections:
   call across the codebase, so this is the live configuration).
 * ``update`` — delta-update/append capability (docs/UPDATE.md):
   supported layouts, crash-safety machinery, CRC fix-up mode.
+* ``strategies`` — GEMM-strategy capability (docs/XOR.md): per-backend
+  ``auto`` candidates and verdict (the tune.py autotuner), plus cached
+  XOR-schedule stats (term counts before/after CSE).
 * ``ledger`` — RS_RUNLOG presence, record count, writability.
 * ``metrics_endpoint`` — RS_METRICS_PORT reachability (one local HTTP
   probe of ``/healthz``).
@@ -51,7 +54,8 @@ SCHEMA_VERSION = 1
 # The --json document's stable surface (pinned by tests): these keys are
 # always present, whatever the environment looks like.
 SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "update",
-            "ledger", "metrics_endpoint", "serve", "roofline")
+            "strategies", "ledger", "metrics_endpoint", "serve",
+            "roofline")
 
 
 def _jax_section() -> dict:
@@ -170,6 +174,55 @@ def _update_section() -> dict:
             "undo journal + atomic generation-bumped .METADATA rewrite"
         )
         out["crc_fixup"] = "seekable crc32-combine (no full-chunk re-hash)"
+    except Exception as e:  # pragma: no cover - import-degraded env
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _strategies_section() -> dict:
+    """GEMM-strategy capability matrix (schema-stable): which strategies
+    this build offers, what ``auto`` resolves to on this backend (the
+    autotuner verdict — docs/XOR.md), and the cached XOR-schedule stats
+    (term counts before/after CSE) so plan-cache bloat is visible."""
+    out: dict = {
+        "valid": [],
+        "candidates": [],
+        "auto": {"strategy": None, "mode": None, "source": None},
+        "xor": {
+            "supported_w": [8, 16],
+            "cse_default": None,
+            "schedules": [],
+            "pipelines": 0,
+        },
+        "autotune_decisions": {},
+        "error": None,
+    }
+    try:
+        from ..ops import xor_gemm as _xg
+        from .. import tune as _tune
+
+        out["valid"] = list(_tune.VALID_STRATEGIES)
+        out["candidates"] = list(_tune.candidate_strategies())
+        mode = _tune.mode()
+        decisions = _tune.decisions()
+        # The verdict an auto codec gets today, mirroring resolve_auto:
+        # `off` mode ignores the cache; measured decisions are per
+        # (k, p, w) class, so a unanimous winner reports as measured and
+        # split winners fall back to the prior label with the per-class
+        # table below telling the full story.
+        winners = sorted({d["strategy"] for d in decisions.values()})
+        if mode == "off" or not winners:
+            auto = {"strategy": _tune.static_choice(), "source": "prior"}
+        elif len(winners) == 1:
+            auto = {"strategy": winners[0], "source": "measured"}
+        else:
+            auto = {"strategy": _tune.static_choice(), "source": "mixed"}
+        out["auto"] = dict(auto, mode=mode)
+        scheds = _xg.schedule_stats()
+        out["xor"]["cse_default"] = _xg._cse_enabled()
+        out["xor"]["schedules"] = scheds
+        out["xor"]["pipelines"] = len(_xg.pipeline_stats())
+        out["autotune_decisions"] = decisions
     except Exception as e:  # pragma: no cover - import-degraded env
         out["error"] = f"{type(e).__name__}: {e}"
     return out
@@ -325,6 +378,7 @@ def collect(probe_endpoint: bool = True) -> dict:
         },
         "decoder": _decoder_section(),
         "update": _update_section(),
+        "strategies": _strategies_section(),
         "ledger": ledger,
         "metrics_endpoint": _endpoint_section(probe_endpoint),
         "serve": _serve_section(probe_endpoint),
@@ -391,6 +445,23 @@ def render(report: dict) -> str:
             f"{report['update']['crash_safety']}"
             if report["update"]["delta_update"]
             else f"unavailable ({report['update']['error']})"
+        ),
+        f"[{mark(not report['strategies']['error'])}] strategies: "
+        + (
+            f"{'/'.join(report['strategies']['candidates'])} compete for "
+            f"auto -> {report['strategies']['auto']['strategy']} "
+            f"({report['strategies']['auto']['source']}, mode "
+            f"{report['strategies']['auto']['mode']}); xor schedules "
+            f"{len(report['strategies']['xor']['schedules'])} cached"
+            + (
+                ", " + ", ".join(
+                    f"{s['digest']}:{s['terms_naive']}->{s['xors']} xors"
+                    for s in report["strategies"]["xor"]["schedules"][:3]
+                )
+                if report["strategies"]["xor"]["schedules"] else ""
+            )
+            if not report["strategies"]["error"]
+            else f"unavailable ({report['strategies']['error']})"
         ),
         f"[{mark(led['writable'])}] ledger: "
         + (f"{led['path']} ({led['records']} records)"

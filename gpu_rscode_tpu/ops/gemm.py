@@ -24,7 +24,15 @@ TPU-native design — NOT a translation of the reference's table-lookup loops:
   study showed multiply-strategy choice must be measured, not assumed
   (design.tex:469-512).
 
-Both paths are bit-exact vs the NumPy oracle (:meth:`..ops.gf.GaloisField.matmul`).
+* **xor (bitsliced, CPU-first):** the GF GEMM lowered to pure XOR over
+  packed uint32 bit-planes with a CSE-scheduled XOR chain per output
+  plane (:mod:`.xor_gemm`, docs/XOR.md) — no tables, no 8x HBM
+  expansion, the SIMD-era XOR-EC formulation (arXiv 2108.02692).  Its
+  schedule depends on the coefficient VALUES, so it dispatches through
+  :func:`.xor_gemm.gf_matmul_xor` / the plan layer (digest-keyed), not
+  through :func:`gf_matmul_jit` (which would trace ``A``).
+
+All paths are bit-exact vs the NumPy oracle (:meth:`..ops.gf.GaloisField.matmul`).
 """
 
 from __future__ import annotations
@@ -39,7 +47,7 @@ import numpy as np
 from .gf import get_field
 from .gf_jax import tables
 
-Strategy = Literal["bitplane", "table", "pallas", "cpu"]
+Strategy = Literal["bitplane", "table", "pallas", "xor", "cpu"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -160,6 +168,12 @@ def gf_matmul(
         from .pallas_gemm import gf_matmul_pallas
 
         return gf_matmul_pallas(A, B, w)
+    if strategy == "xor":
+        # Value-dependent schedule: needs a concrete A (gf_matmul_xor
+        # raises an actionable TypeError on a tracer).
+        from .xor_gemm import gf_matmul_xor
+
+        return gf_matmul_xor(A, B, w)
     raise ValueError(f"unknown strategy {strategy!r}")
 
 

@@ -1,0 +1,626 @@
+"""XOR-lowered bitsliced GF(2^w) GEMM — ``strategy="xor"`` (docs/XOR.md).
+
+The table strategy gathers, the bitplane strategy matmuls; this strategy
+does neither: it lowers the tiny GF(2^w) coefficient matrix to its
+``(rows*w, k*w)`` GF(2) binary equivalent (each symbol becomes the w x w
+bit-matrix of multiply-by-that-constant, ``gf.bitmatrix``) and evaluates
+the product as **pure XOR accumulation over packed bit-planes** — the
+scheme the XOR-EC literature vectorizes with SIMD (arXiv 2108.02692,
+arXiv 1909.02871) and Jerasure calls bit-matrix coding, expressed here
+in XLA uint32 ops so it runs identically on CPU and TPU backends with no
+lookup tables and no native extension.
+
+Three stages, compiled as three AOT executables per (matrix digest,
+shape bucket) and composed by :class:`XorPipeline`:
+
+* **pack** — bit-transpose each data row into w bit-plane vectors of
+  packed uint32 words.  An 8x8 bit transpose costs 3 rounds of SWAR
+  delta-swaps (Hacker's Delight 7-3, little-endian variant) plus a 4x4
+  byte transpose done with shift/mask ops.  Word pairing uses
+  *contiguous half/quarter splits* instead of memory-strided pairs: the
+  XOR algebra only needs every plane to list symbol bits in the SAME
+  position order, not in any PARTICULAR order, so the layout is chosen
+  to make every load contiguous and the unpack a pure concatenation —
+  measured ~2x over the strided form on XLA CPU.
+* **xor chain** — one XOR-tree per output plane, selected by the binary
+  matrix rows, after greedy pair-frequency CSE (Paar's algorithm) has
+  rewritten shared column pairs into reusable intermediate nodes.
+  Planes travel as TUPLES of separate arrays: stacking them into one
+  (planes, words) array forces XLA CPU through a layout copy that was
+  measured 3x slower than the tuple form.
+* **unpack** — the inverse transform on the ``rows_out * w`` output
+  planes; with the contiguous-split pairing this is elementwise ops plus
+  one concatenate, then a bitcast back to uint8/uint16 symbols.
+
+The stages are deliberately SEPARATE executables: fused into one XLA
+program, the compiler rematerializes pack subexpressions into every
+chain consumer and the whole thing runs ~2x slower than the sum of its
+parts (measured on XLA CPU; see docs/XOR.md for the numbers).
+
+Env knobs (read at schedule build / pipeline compile time):
+
+* ``RS_XOR_CSE=0`` — disable Paar CSE (naive per-row term lists; larger
+  executables, occasionally a hair faster on XLA CPU).
+* ``RS_XOR_MAX_TERMS`` — refuse to build schedules whose naive term
+  count exceeds this (default 32768): compile time scales with the term
+  count, and a pathological (k, rows, w) combination should fail with an
+  actionable error instead of hanging the build.
+"""
+
+from __future__ import annotations
+
+import functools
+import hashlib
+import os
+import threading
+import time
+from dataclasses import dataclass
+
+import numpy as np
+
+from .gf import get_field
+
+__all__ = [
+    "XorSchedule", "XorPipeline", "build_schedule", "matrix_digest",
+    "gf_matmul_xor", "get_pipeline", "clear_pipeline_cache",
+    "schedule_stats", "pipeline_stats",
+]
+
+_SUPPORTED_W = (8, 16)
+
+# Symbol columns are padded up to a multiple of 32 so every row's byte
+# stream splits into whole 8-byte SWAR blocks grouped in quads.
+_COL_ALIGN = 32
+
+
+def _max_terms() -> int:
+    try:
+        v = int(os.environ.get("RS_XOR_MAX_TERMS", "32768"))
+        return v if v > 0 else 32768
+    except ValueError:
+        return 32768
+
+
+def _cse_enabled() -> bool:
+    return os.environ.get("RS_XOR_CSE", "1").lower() not in (
+        "0", "false", "off", "no"
+    )
+
+
+# -- binary-matrix lowering (host) -------------------------------------------
+
+
+def binary_matrix(A: np.ndarray, w: int) -> np.ndarray:
+    """(rows, k) GF(2^w) matrix -> (rows*w, k*w) uint8 0/1 operator.
+
+    Block (ri, ki) is ``gf.bitmatrix(A[ri, ki])``: bits(c*b) = M_c @
+    bits(b) over GF(2).  Built per distinct value so w=16 never
+    materialises the full 16 MB ``gf.bitmats`` table for a handful of
+    coefficients.
+    """
+    gf = get_field(w)
+    A = np.asarray(A)
+    rows, k = A.shape
+    mats = {int(v): gf.bitmatrix(int(v)) for v in np.unique(A)}
+    blocks = np.empty((rows, k, w, w), dtype=np.uint8)
+    for ri in range(rows):
+        for ki in range(k):
+            blocks[ri, ki] = mats[int(A[ri, ki])]
+    return blocks.transpose(0, 2, 1, 3).reshape(rows * w, k * w)
+
+
+def matrix_digest(A, w: int) -> str:
+    """Stable identity of a coefficient matrix for schedule/plan keying."""
+    A = np.ascontiguousarray(np.asarray(A))
+    h = hashlib.blake2b(digest_size=8)
+    h.update(f"{w}:{A.shape[0]}x{A.shape[1]}:{A.dtype.str}".encode())
+    h.update(A.tobytes())
+    return h.hexdigest()
+
+
+# -- greedy pair-frequency CSE (Paar) ----------------------------------------
+
+
+def paar_cse(rows: list[set[int]], n_inputs: int):
+    """Greedy pair-frequency elimination over the binary-matrix rows.
+
+    Repeatedly finds the column pair co-occurring in the most rows and
+    rewrites it into a fresh node (one shared XOR), until no pair occurs
+    twice — Paar's classic XOR-count minimisation.  Incremental: the
+    symmetric co-occurrence matrix grows geometrically and only the
+    touched rows' outer products move per step, with a per-column
+    row-index map so a step visits exactly the rows it rewrites —
+    decode-sized matrices (256x256) schedule in well under a second.
+
+    Returns ``(pair_ops, rows)`` where ``pair_ops[t] = (a, b)`` defines
+    node ``n_inputs + t`` and ``rows`` holds each output's remaining
+    term sets (referencing inputs and nodes).
+    """
+    cap = max(16, 2 * n_inputs)
+    co = np.zeros((cap, cap), dtype=np.int32)
+    rows_with: dict[int, set[int]] = {}
+    for ri, s in enumerate(rows):
+        idx = np.fromiter(s, dtype=np.int64, count=len(s))
+        co[np.ix_(idx, idx)] += 1
+        for c in s:
+            rows_with.setdefault(c, set()).add(ri)
+    n = n_inputs
+    pair_ops: list[tuple[int, int]] = []
+    while True:
+        live = co[:n, :n]
+        np.fill_diagonal(live, 0)  # self-pairs from the outer updates
+        flat = int(np.argmax(live))
+        a, b = flat // n, flat % n
+        if live[a, b] < 2:
+            break
+        if a > b:
+            a, b = b, a
+        if n == cap:
+            grown = np.zeros((2 * cap, 2 * cap), dtype=np.int32)
+            grown[:cap, :cap] = co
+            co, cap = grown, 2 * cap
+        for ri in list(rows_with[a] & rows_with[b]):
+            s = rows[ri]
+            idx = np.fromiter(s, dtype=np.int64, count=len(s))
+            co[np.ix_(idx, idx)] -= 1
+            s.discard(a)
+            s.discard(b)
+            s.add(n)
+            rows_with[a].discard(ri)
+            rows_with[b].discard(ri)
+            rows_with.setdefault(n, set()).add(ri)
+            idx = np.fromiter(s, dtype=np.int64, count=len(s))
+            co[np.ix_(idx, idx)] += 1
+        pair_ops.append((int(a), int(b)))
+        n += 1
+    return pair_ops, rows
+
+
+# -- schedule ----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class XorSchedule:
+    """One lowered+scheduled coefficient matrix (hashable, immutable).
+
+    ``pair_ops`` are the CSE nodes (node ``n_inputs + t`` = XOR of the
+    two referenced nodes); ``rows`` lists each output plane's term nodes
+    (empty tuple -> the output plane is zero).
+    """
+
+    digest: str
+    w: int
+    rows_out: int
+    k: int
+    n_inputs: int
+    pair_ops: tuple[tuple[int, int], ...]
+    rows: tuple[tuple[int, ...], ...]
+    terms_naive: int
+    terms_cse: int
+    cse: bool
+    build_seconds: float
+
+    @property
+    def xors(self) -> int:
+        """XOR ops one dispatch evaluates (per packed word column)."""
+        return len(self.pair_ops) + sum(
+            max(0, len(r) - 1) for r in self.rows
+        )
+
+
+_SCHEDULE_CACHE: dict[tuple, XorSchedule] = {}
+_SCHEDULE_LOCK = threading.Lock()
+
+
+def build_schedule(A, w: int, cse: bool | None = None) -> XorSchedule:
+    """Lower ``A`` to GF(2) and CSE-schedule it, cached by digest."""
+    if w not in _SUPPORTED_W:
+        raise ValueError(
+            f"strategy='xor' supports w in {_SUPPORTED_W}, got w={w}"
+        )
+    if cse is None:
+        cse = _cse_enabled()
+    A = np.asarray(A)
+    digest = matrix_digest(A, w)
+    key = (digest, bool(cse))
+    with _SCHEDULE_LOCK:
+        hit = _SCHEDULE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    t0 = time.perf_counter()
+    abin = binary_matrix(A, w)
+    naive = int(abin.sum())
+    limit = _max_terms()
+    if naive > limit:
+        raise ValueError(
+            f"xor schedule for {A.shape[0]}x{A.shape[1]} w={w} needs "
+            f"{naive} XOR terms, over RS_XOR_MAX_TERMS={limit}; use "
+            "strategy='bitplane' (or raise the knob) for matrices this "
+            "large"
+        )
+    row_sets = [set(np.nonzero(r)[0]) for r in abin]
+    if cse:
+        pair_ops, row_sets = paar_cse(row_sets, abin.shape[1])
+    else:
+        pair_ops = []
+    sched = XorSchedule(
+        digest=digest,
+        w=w,
+        rows_out=A.shape[0],
+        k=A.shape[1],
+        n_inputs=abin.shape[1],
+        pair_ops=tuple(pair_ops),
+        rows=tuple(tuple(sorted(s)) for s in row_sets),
+        terms_naive=naive,
+        terms_cse=len(pair_ops) + sum(len(s) for s in row_sets),
+        cse=bool(cse),
+        build_seconds=time.perf_counter() - t0,
+    )
+    with _SCHEDULE_LOCK:
+        return _SCHEDULE_CACHE.setdefault(key, sched)
+
+
+def schedule_stats() -> list[dict]:
+    """Built schedules (digest, shape, term counts before/after CSE) —
+    the `rs doctor` surface that makes plan-cache bloat visible."""
+    with _SCHEDULE_LOCK:
+        scheds = list(_SCHEDULE_CACHE.values())
+    return [
+        {
+            "digest": s.digest,
+            "w": s.w,
+            "rows_out": s.rows_out,
+            "k": s.k,
+            "cse": s.cse,
+            "terms_naive": s.terms_naive,
+            "terms_cse": s.terms_cse,
+            "xors": s.xors,
+            "build_seconds": round(s.build_seconds, 6),
+        }
+        for s in scheds
+    ]
+
+
+# -- packed bit-plane transforms (traced) ------------------------------------
+#
+# All constants/widths below are the little-endian uint32 formulation;
+# the SWAR pair transpose maps virtual-block bit (i, j) to lane
+# (j+4) % 8, bit (i+4) % 8 — an involution, verified exhaustively in
+# tests/test_xor_gemm.py.
+
+_PLANE_LANE = tuple((j + 4) % 8 for j in range(8))
+
+
+def _u32(v):
+    import jax.numpy as jnp
+
+    return jnp.uint32(v)
+
+
+def _dswap(x, mask, shift):
+    t = (x ^ (x >> shift)) & mask
+    return x ^ t ^ (t << shift)
+
+
+def _swar_pair(x, y):
+    """8x8 bit transpose of virtual blocks (x[t] bytes 0-3, y[t] 4-7)."""
+    m1, m2 = _u32(0x00AA00AA), _u32(0x0000CCCC)
+    hi, lo = _u32(0xF0F0F0F0), _u32(0x0F0F0F0F)
+    x = _dswap(x, m1, 7)
+    y = _dswap(y, m1, 7)
+    x = _dswap(x, m2, 14)
+    y = _dswap(y, m2, 14)
+    t = (x & hi) | ((y >> 4) & lo)
+    y = ((x << 4) & hi) | (y & lo)
+    return t, y
+
+
+def _t4x4(x0, x1, x2, x3):
+    """4x4 byte transpose across four uint32 streams (shift/mask only)."""
+    low16, hi16 = _u32(0x0000FFFF), _u32(0xFFFF0000)
+    ev, od = _u32(0x00FF00FF), _u32(0xFF00FF00)
+    t0 = (x0 & low16) | (x2 << 16)
+    t1 = (x1 & low16) | (x3 << 16)
+    t2 = (x0 >> 16) | (x2 & hi16)
+    t3 = (x1 >> 16) | (x3 & hi16)
+    u0 = (t0 & ev) | ((t1 & ev) << 8)
+    u1 = ((t0 >> 8) & ev) | (t1 & od)
+    u2 = (t2 & ev) | ((t3 & ev) << 8)
+    u3 = ((t2 >> 8) & ev) | (t3 & od)
+    return u0, u1, u2, u3
+
+
+def _split(a, n):
+    step = a.shape[0] // n
+    return [a[i * step:(i + 1) * step] for i in range(n)]
+
+
+def _pack_words(w):
+    """(nw4,) uint32 of raw bytes -> tuple of 8 (nw4//8,) plane words.
+
+    Contiguous half/quarter pairing: virtual block t = (first-half word
+    t, second-half word t); quads likewise.  Planes come back indexed by
+    TRUE bit number via the lane permutation.
+    """
+    xh, yh = _split(w, 2)
+    x, y = _swar_pair(xh, yh)
+    lanes = list(_t4x4(*_split(x, 4))) + list(_t4x4(*_split(y, 4)))
+    return tuple(lanes[_PLANE_LANE[j]] for j in range(8))
+
+
+def _unpack_words(planes):
+    """Inverse of :func:`_pack_words`, returned as 8 contiguous pieces
+    (concatenate in order to recover the raw byte words)."""
+    lanes = [None] * 8
+    for j in range(8):
+        lanes[_PLANE_LANE[j]] = planes[j]
+    xs = _t4x4(*lanes[:4])
+    ys = _t4x4(*lanes[4:])
+    xps, yps = [], []
+    for s in range(4):
+        a, b = _swar_pair(xs[s], ys[s])
+        xps.append(a)
+        yps.append(b)
+    return xps + yps
+
+
+_LOBYTES = 0x00FF00FF
+
+
+def _pack_row(row, w: int):
+    """One data row -> tuple of ``w`` packed plane vectors."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    if w == 8:
+        words = lax.bitcast_convert_type(row.reshape(-1, 4), jnp.uint32)
+        return _pack_words(words)
+    # w == 16, little-endian symbols: split lo/hi byte streams with
+    # shift/mask compaction (contiguous-half pairing again), then run
+    # the byte machinery per stream — planes 0-7 from lo, 8-15 from hi.
+    m = _u32(_LOBYTES)
+    words = lax.bitcast_convert_type(row.reshape(-1, 2), jnp.uint32)
+    lo_sp, hi_sp = words & m, (words >> 8) & m
+    lo_a, lo_b = _split(lo_sp, 2)
+    hi_a, hi_b = _split(hi_sp, 2)
+    lo = lo_a | (lo_b << 8)
+    hi = hi_a | (hi_b << 8)
+    return _pack_words(lo) + _pack_words(hi)
+
+
+def _unpack_row_pieces(planes, w: int):
+    """Output planes of one row -> contiguous uint32 pieces, in order."""
+    if w == 8:
+        return _unpack_words(planes)
+    m = _u32(_LOBYTES)
+    lo_ps = _unpack_words(planes[:8])
+    hi_ps = _unpack_words(planes[8:])
+    first = [
+        (lp & m) | ((hp & m) << 8) for lp, hp in zip(lo_ps, hi_ps)
+    ]
+    second = [
+        ((lp >> 8) & m) | ((hp & ~m))
+        for lp, hp in zip(lo_ps, hi_ps)
+    ]
+    return first + second
+
+
+def _xor_tree(xs):
+    while len(xs) > 1:
+        xs = [
+            xs[i] ^ xs[i + 1] if i + 1 < len(xs) else xs[i]
+            for i in range(0, len(xs), 2)
+        ]
+    return xs[0]
+
+
+# -- the three stage programs ------------------------------------------------
+
+
+def _pack_stage(B, w: int):
+    k = B.shape[0]
+    out = []
+    for i in range(k):
+        out.extend(_pack_row(B[i], w))
+    return tuple(out)
+
+
+def _chain_stage(nodes, schedule: XorSchedule):
+    import jax.numpy as jnp
+
+    nodes = list(nodes)
+    for a, b in schedule.pair_ops:
+        nodes.append(nodes[a] ^ nodes[b])
+    return tuple(
+        _xor_tree([nodes[t] for t in terms]) if terms
+        else jnp.zeros_like(nodes[0])
+        for terms in schedule.rows
+    )
+
+
+def _unpack_stage(outs, schedule: XorSchedule, cols: int):
+    import jax.numpy as jnp
+    from jax import lax
+
+    w, rows_out = schedule.w, schedule.rows_out
+    pieces = []
+    for ri in range(rows_out):
+        pieces.extend(_unpack_row_pieces(outs[ri * w:(ri + 1) * w], w))
+    words = jnp.concatenate(pieces)
+    if w == 8:
+        return lax.bitcast_convert_type(words, jnp.uint8).reshape(
+            rows_out, cols
+        )
+    return lax.bitcast_convert_type(words, jnp.uint16).reshape(
+        rows_out, cols
+    )
+
+
+# -- compiled pipeline -------------------------------------------------------
+
+
+class XorPipeline:
+    """Three AOT executables for one (schedule, k, padded-cols, dtype).
+
+    Callable with the plan layer's ``(A, B)`` convention — ``A`` is
+    ignored (its values are baked into the schedule; the plan key
+    carries its digest).  ``B`` must already be padded to ``cols``.
+    """
+
+    __slots__ = (
+        "schedule", "k", "cols", "dtype", "compile_seconds",
+        "cost_analysis", "calls", "_pack", "_chain", "_unpack",
+    )
+
+    def __init__(self, schedule: XorSchedule, k: int, cols: int, dtype):
+        import jax
+
+        if cols % _COL_ALIGN:
+            raise ValueError(
+                f"xor pipeline cols must be {_COL_ALIGN}-aligned, "
+                f"got {cols}"
+            )
+        self.schedule = schedule
+        self.k = k
+        self.cols = cols
+        self.dtype = np.dtype(dtype)
+        self.calls = 0
+        t0 = time.perf_counter()
+        w = schedule.w
+        b_struct = jax.ShapeDtypeStruct((k, cols), self.dtype)
+        self._pack = (
+            jax.jit(lambda b: _pack_stage(b, w))
+            .lower(b_struct).compile()
+        )
+        # One plane vector holds one bit of every symbol column: cols/32
+        # packed uint32 words for BOTH widths (w=16 splits into lo/hi
+        # byte streams first, doubling the plane count, not their size).
+        nw = cols // _COL_ALIGN
+        plane = jax.ShapeDtypeStruct((nw,), np.uint32)
+        nodes_struct = tuple([plane] * (k * w))
+        self._chain = (
+            jax.jit(lambda ns: _chain_stage(ns, schedule))
+            .lower(nodes_struct).compile()
+        )
+        outs_struct = tuple([plane] * (schedule.rows_out * w))
+        self._unpack = (
+            jax.jit(lambda os: _unpack_stage(os, schedule, cols))
+            .lower(outs_struct).compile()
+        )
+        self.compile_seconds = time.perf_counter() - t0
+        self.cost_analysis = self._merged_cost()
+
+    def _merged_cost(self):
+        from ..obs.attrib import extract_cost_analysis
+
+        total: dict = {}
+        for exe in (self._pack, self._chain, self._unpack):
+            ca = extract_cost_analysis(exe)
+            if not ca:
+                return None
+            for key, v in ca.items():
+                total[key] = total.get(key, 0.0) + v
+        return total or None
+
+    def __call__(self, A, B):
+        self.calls += 1
+        return self._unpack(self._chain(self._pack(B)))
+
+    def describe(self) -> dict:
+        s = self.schedule
+        return {
+            "digest": s.digest,
+            "w": s.w,
+            "k": self.k,
+            "rows_out": s.rows_out,
+            "cols": self.cols,
+            "cse": s.cse,
+            "terms_naive": s.terms_naive,
+            "terms_cse": s.terms_cse,
+            "xors": s.xors,
+            "calls": self.calls,
+            "compile_seconds": round(self.compile_seconds, 6),
+        }
+
+
+_PIPELINE_CACHE: dict[tuple, XorPipeline] = {}
+_PIPELINE_LOCK = threading.Lock()
+
+
+def get_pipeline(A, B_shape, B_dtype, w: int) -> XorPipeline:
+    """Build-or-fetch the compiled pipeline for concrete coefficients
+    ``A`` and a (k, cols) operand class.  ``cols`` must be 32-aligned
+    (use :func:`padded_cols`)."""
+    schedule = build_schedule(A, w)
+    k, cols = B_shape
+    key = (schedule.digest, schedule.cse, k, cols, np.dtype(B_dtype).str)
+    with _PIPELINE_LOCK:
+        pipe = _PIPELINE_CACHE.get(key)
+        if pipe is None:
+            pipe = _PIPELINE_CACHE[key] = XorPipeline(
+                schedule, k, cols, B_dtype
+            )
+        return pipe
+
+
+def clear_pipeline_cache() -> None:
+    """Drop compiled pipelines AND schedules (paired with plan-cache
+    clears: both pin executables XLA may since have evicted)."""
+    with _PIPELINE_LOCK:
+        _PIPELINE_CACHE.clear()
+    with _SCHEDULE_LOCK:
+        _SCHEDULE_CACHE.clear()
+
+
+def pipeline_stats() -> list[dict]:
+    with _PIPELINE_LOCK:
+        pipes = list(_PIPELINE_CACHE.values())
+    return [p.describe() for p in pipes]
+
+
+def padded_cols(m: int) -> int:
+    """Round a column count up to the pipeline's 32-symbol alignment."""
+    return max(_COL_ALIGN, (m + _COL_ALIGN - 1) // _COL_ALIGN * _COL_ALIGN)
+
+
+def gf_matmul_xor(A, B, w: int = 8):
+    """``C = A . B`` over GF(2^w) via the XOR pipeline (eager entry).
+
+    ``A`` must be concrete (its VALUES select the schedule — under a
+    ``jit`` trace it would be a tracer, which cannot key a schedule; the
+    plan layer passes concrete coefficients by construction).  ``B`` may
+    be a device array; ragged widths are zero-padded to the 32-symbol
+    alignment and trimmed after (GF linearity makes pad columns zero).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(A, jax.core.Tracer):
+        raise TypeError(
+            "strategy='xor' needs concrete coefficient values to build "
+            "its XOR schedule; call it outside jit (or via the plan "
+            "layer), not on a traced A"
+        )
+    A = np.asarray(A)
+    gf = get_field(w)
+    dtype = np.dtype(gf.dtype)
+    rows_out, k = A.shape
+    m = B.shape[1]
+    if m == 0:
+        return jnp.zeros((rows_out, 0), dtype=dtype)
+    cols = padded_cols(m)
+    if B.shape[1] != cols:
+        B = jnp.asarray(B)
+        B = jnp.pad(B, ((0, 0), (0, cols - m)))
+    if isinstance(B, jax.core.Tracer):
+        # Under a caller's jit the compiled pipeline cannot run; trace
+        # the three stage programs inline instead (the schedule is still
+        # concrete — only the data is traced).
+        schedule = build_schedule(A, w)
+        out = _unpack_stage(
+            _chain_stage(_pack_stage(B, w), schedule), schedule, cols
+        )
+    else:
+        pipe = get_pipeline(A, (k, cols), dtype, w)
+        out = pipe(A, B)
+    return out[:, :m] if cols != m else out

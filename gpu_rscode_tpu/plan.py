@@ -39,6 +39,14 @@ Dispatch strategy per plan:
   Under autotune the plan times its OWN compiled refold candidates
   (``pallas_gemm.calibrate_aot_refold``) — the eager decision described a
   different compile, and dot speed at w=16 is per-compile bimodal.
+* ``xor`` — the plan key additionally carries the COEFFICIENT MATRIX
+  DIGEST (the XOR schedule is a function of the matrix values, not just
+  its shape), and the cached callable is a composite of three stage
+  executables (pack / xor-chain / unpack, ops/xor_gemm.py) — XLA fuses
+  a monolithic emission ~2x slower than the staged one.  One schedule
+  per digest, never one per dispatch; schedule term counts surface in
+  ``describe()`` and ``rs doctor``.  Donation is skipped (the stage
+  split owns its intermediates).
 * mesh plans — counted and fingerprinted, but the callable is the
   existing jitted ``sharded_gf_matmul`` (XLA's jit cache pins the
   executable; donation is skipped — sharded inputs may be caller-held).
@@ -209,7 +217,8 @@ class ExecutionPlan:
 
     __slots__ = (
         "key", "strategy", "w", "bucket", "refold", "calls", "donated_calls",
-        "compile_seconds", "cost_analysis", "_compiled", "_lock",
+        "compile_seconds", "cost_analysis", "xor_stats", "_compiled",
+        "_lock",
     )
 
     def __init__(self, key, strategy, w, bucket):
@@ -222,6 +231,7 @@ class ExecutionPlan:
         self.donated_calls = 0
         self.compile_seconds = 0.0  # lower+compile wall across all variants
         self.cost_analysis = None   # XLA cost model of one dispatch, or None
+        self.xor_stats = None       # xor plans: schedule term counts
         self._compiled: dict = {}   # donate(bool) -> jax Compiled
         self._lock = threading.Lock()   # serializes this plan's builds
 
@@ -262,6 +272,29 @@ class ExecutionPlan:
         propagate to the dispatch site, where the codec's pallas guard can
         demote exactly like an eager failure."""
         w, strategy = self.w, self.strategy
+        if strategy == "xor":
+            # Digest-keyed composite pipeline (ops/xor_gemm.py): three
+            # stage executables whose XOR schedule is baked from the
+            # CONCRETE coefficients (the plan key carries the matrix
+            # digest, so one schedule serves every dispatch of this
+            # matrix — never one per dispatch).  The pipeline cache is
+            # shared with the eager path and cleared with this cache.
+            # Donation is not applicable: the stage split owns its
+            # intermediates, and dispatch() never requests it for xor.
+            from .ops import xor_gemm as _xg
+
+            t0 = time.perf_counter()
+            pipe = _xg.get_pipeline(np.asarray(A), B.shape, B.dtype, w)
+            dt = time.perf_counter() - t0  # ~0 on a pipeline-cache hit
+            self.compile_seconds += dt
+            if self.cost_analysis is None:
+                self.cost_analysis = pipe.cost_analysis
+            self.xor_stats = pipe.describe()
+            _metrics.histogram(
+                "rs_plan_compile_seconds",
+                "wall seconds spent in AOT lower+compile per plan variant",
+            ).labels(strategy=strategy).observe(dt)
+            return pipe
         if strategy == "pallas":
             from .ops import pallas_gemm as _pg
 
@@ -324,7 +357,7 @@ class ExecutionPlan:
     def describe(self) -> dict:
         with self._lock:  # a concurrent _build may be inserting a variant
             variants = list(self._compiled)
-        return {
+        out = {
             "strategy": self.strategy,
             "w": self.w,
             "bucket": self.bucket,
@@ -340,6 +373,11 @@ class ExecutionPlan:
             "compile_seconds": self.compile_seconds,
             "cost_analysis": self.cost_analysis,
         }
+        if self.xor_stats is not None:
+            # Schedule economy for `rs doctor`: terms before/after CSE
+            # and the matrix digest this plan is keyed by.
+            out["xor"] = self.xor_stats
+        return out
 
 
 class PlanCache:
@@ -408,8 +446,10 @@ class PlanCache:
             self._plans.clear()
             self.hits = self.misses = self.evictions = 0
         from .ops.pallas_gemm import clear_autotune_cache
+        from .ops.xor_gemm import clear_pipeline_cache
 
         clear_autotune_cache()
+        clear_pipeline_cache()
 
     def stats(self) -> dict:
         # Snapshot under the cache lock, describe() OUTSIDE it: describe
@@ -485,6 +525,17 @@ def dispatch(
         str(np.dtype(B.dtype)),
         mesh_fingerprint(None),
     )
+    if strategy == "xor":
+        # The XOR schedule is a function of the coefficient VALUES, so
+        # the plan key carries the matrix digest (one compiled schedule
+        # per matrix, shared by every dispatch — docs/XOR.md); the
+        # bucket additionally rounds up to the pipeline's 32-symbol
+        # pack alignment (ragged caps only — ladder buckets are already
+        # 128-aligned).
+        from .ops.xor_gemm import matrix_digest, padded_cols
+
+        bucket = max(bucket, padded_cols(bucket))
+        key = key[:4] + (bucket,) + key[5:] + (matrix_digest(A, w),)
     plan = PLAN_CACHE.lookup(key, strategy, w, bucket)
     B = _pad_to(B, bucket)
     if eager_fn is not None:
@@ -496,8 +547,9 @@ def dispatch(
         # output can only reuse B's (k, m) buffer when rows == k (full-k
         # decode/repair).  Encode's p < k dispatch would just compile a
         # donate variant that warns 'donated buffers were not usable' and
-        # aliases nothing — drop the request instead.
-        can_alias = A.shape[0] == B.shape[0]
+        # aliases nothing — drop the request instead.  The xor pipeline
+        # never donates: its stage split owns the intermediate planes.
+        can_alias = A.shape[0] == B.shape[0] and strategy != "xor"
         out = plan.run(A, B, donate and can_alias and _donation_allowed())
     return out if bucket == m else out[:, :m]
 

@@ -45,8 +45,8 @@ def main(argv=None) -> int:
     ap.add_argument("--iters", type=int, default=3)
     ap.add_argument(
         "--strategies",
-        default="bitplane,table,pallas,cpu,numpy",
-        help="comma list from bitplane,table,pallas,cpu,numpy",
+        default="bitplane,table,xor,pallas,cpu,numpy",
+        help="comma list from bitplane,table,xor,pallas,cpu,numpy",
     )
     args = ap.parse_args(argv)
 
@@ -63,6 +63,7 @@ def main(argv=None) -> int:
     from ..ops.gemm import gf_matmul_jit
     from ..ops.gf import get_field
     from ..ops.pallas_gemm import gf_matmul_pallas
+    from ..ops.xor_gemm import gf_matmul_xor
 
     k, p = args.k, args.p
     m = int(args.size * 1e6 / k)
@@ -76,6 +77,7 @@ def main(argv=None) -> int:
     runners = {
         "bitplane": lambda: gf_matmul_jit(Ad, Bd, strategy="bitplane"),
         "table": lambda: gf_matmul_jit(Ad, Bd, strategy="table"),
+        "xor": lambda: gf_matmul_xor(A, Bd, 8),
         "pallas": lambda: gf_matmul_pallas(Ad, Bd),
         "cpu": lambda: native.gemm(A, B),
         "numpy": lambda: get_field(8).matmul(A, B),

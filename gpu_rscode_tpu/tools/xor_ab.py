@@ -1,0 +1,198 @@
+"""``tools/xor_ab.py --ab`` — XOR-lowered strategy vs table, paired A/B.
+
+The acceptance measurement for ``strategy="xor"`` (docs/XOR.md): on the
+bench workload shape (the BENCH trajectory's k=10, p=4 stripe encode),
+the bitsliced XOR lowering must beat the best prior pure-JAX strategy
+(``table``) by ≥ 3x achieved encode GB/s on CPU.
+
+A/B discipline (matching tools/io_bench.py / update_bench.py): paired,
+interleaved best-of-``--trials`` — each trial visits every strategy on
+the SAME device-resident stripe, so machine noise hits all arms alike.
+Within a trial each arm runs TWICE consecutively and the second run is
+recorded: the codec dispatches one strategy back-to-back down a file's
+segment loop, so the warm-streak number is the production-representative
+one for every arm (the first run just flushes the other arm's cache and
+allocator state).  Every strategy's output is verified bit-identical
+against the NumPy GF oracle on a leading slab before any timing counts.
+The capture row records per-strategy GB/s plus the xor/table speedup;
+``bench_captures/xor_ab_*.jsonl`` joins the BENCH trajectory via the
+shared ``capture_header``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_DEFAULT_STRATEGIES = "xor,table"
+_VERIFY_COLS = 4096
+
+
+def _runner(name: str, A, Bd, w: int):
+    if name == "xor":
+        from ..ops.xor_gemm import gf_matmul_xor
+
+        return lambda b: gf_matmul_xor(A, b, w)
+    if name == "pallas":
+        from ..ops.pallas_gemm import gf_matmul_pallas
+
+        return lambda b: gf_matmul_pallas(A, b, w)
+    if name in ("cpu", "native"):
+        from .. import native
+
+        import numpy as np
+
+        Ah = np.asarray(A)
+        return lambda b: native.gemm(Ah, np.asarray(b))
+    from ..ops.gemm import gf_matmul_jit
+
+    return lambda b: gf_matmul_jit(A, b, w=w, strategy=name)
+
+
+def run_ab(
+    *,
+    size_mb: float,
+    k: int,
+    p: int,
+    w: int,
+    strategies: list[str],
+    trials: int,
+    quiet: bool = False,
+) -> list[dict]:
+    import jax
+    import numpy as np
+
+    from ..models.vandermonde import vandermonde_matrix
+    from ..ops.gf import get_field
+
+    gf = get_field(w)
+    sym = int(np.dtype(gf.dtype).itemsize)
+    # 32-align the stripe so the xor arm's pack alignment never pads
+    # inside the timed region — both arms must measure identical work.
+    m = max(_VERIFY_COLS, int(size_mb * 1024 * 1024) // k // sym) // 32 * 32
+    A = vandermonde_matrix(p, k, gf)
+    rng = np.random.default_rng(20260804)
+    Bh = rng.integers(0, gf.size, size=(k, m)).astype(gf.dtype)
+    Bd = jax.device_put(Bh)
+    data_bytes = k * m * sym
+    oracle = gf.matmul(A, Bh[:, :_VERIFY_COLS])
+
+    runners = {}
+    for name in strategies:
+        fn = _runner(name, A, Bd, w)
+        got = np.asarray(fn(jax.device_put(Bh[:, :_VERIFY_COLS])))
+        if not np.array_equal(
+            got.astype(np.int64), oracle.astype(np.int64)
+        ):
+            raise AssertionError(
+                f"strategy {name!r} disagrees with the GF oracle"
+            )
+        jax.block_until_ready(fn(Bd))  # absorb full-width compiles
+        runners[name] = fn
+
+    walls: dict[str, list[float]] = {name: [] for name in runners}
+    for _ in range(max(1, trials)):
+        for name, fn in runners.items():  # interleaved: paired noise
+            jax.block_until_ready(fn(Bd))  # warm streak (see docstring)
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(Bd))
+            walls[name].append(time.perf_counter() - t0)
+
+    gbps = {
+        name: round(data_bytes / min(ws) / 1e9, 4)
+        for name, ws in walls.items()
+    }
+    speedup = (
+        round(gbps["xor"] / gbps["table"], 3)
+        if gbps.get("xor") and gbps.get("table") else None
+    )
+    row = {
+        "kind": "xor_ab",
+        "op": "encode",
+        "config": {"k": k, "n": k + p, "w": w},
+        "bytes": data_bytes,
+        "trials": trials,
+        "verified_cols": _VERIFY_COLS,
+        "gbps": gbps,
+        "walls_s": {
+            name: [round(x, 6) for x in ws] for name, ws in walls.items()
+        },
+        "xor_over_table": speedup,
+    }
+    if not quiet:
+        detail = "  ".join(f"{n}={g} GB/s" for n, g in gbps.items())
+        print(
+            f"xor_ab: k={k} p={p} w={w} {data_bytes >> 20}MiB stripe: "
+            f"{detail}"
+            + (f"  -> xor/table {speedup}x" if speedup else ""),
+            file=sys.stderr,
+        )
+    return [row]
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ..obs import runlog as _runlog
+
+    ap = argparse.ArgumentParser(
+        prog="xor_ab",
+        description="A/B: the XOR-lowered bitsliced GF GEMM strategy vs "
+        "table (and friends) on the bench workload stripe encode, "
+        "paired best-of-trials, oracle-verified (docs/XOR.md).",
+    )
+    ap.add_argument("--ab", action="store_true",
+                    help="run the A/B comparison (the only mode)")
+    ap.add_argument("--size-mb", type=float, default=20.0,
+                    help="stripe payload in MiB (default 20)")
+    ap.add_argument("--k", type=int, default=10,
+                    help="native chunks (default 10 — the BENCH shape)")
+    ap.add_argument("--p", type=int, default=4,
+                    help="parity chunks (default 4 — the BENCH shape)")
+    ap.add_argument("--w", type=int, default=8, choices=(8, 16))
+    ap.add_argument("--strategies", default=_DEFAULT_STRATEGIES,
+                    help=f"comma list (default {_DEFAULT_STRATEGIES}; "
+                    "also: bitplane, pallas, native)")
+    ap.add_argument("--trials", type=int, default=5)
+    ap.add_argument("--capture", default=None,
+                    help="capture JSONL path (default bench_captures/"
+                    "xor_ab_<backend>_<ts>.jsonl; '-' disables)")
+    ap.add_argument("--json", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+    if not args.ab:
+        print("xor_ab: pass --ab (the A/B comparison is the bench)",
+              file=sys.stderr)
+        return 2
+    strategies = [s.strip() for s in args.strategies.split(",") if s]
+
+    rows = run_ab(
+        size_mb=args.size_mb, k=args.k, p=args.p, w=args.w,
+        strategies=strategies, trials=args.trials, quiet=args.json,
+    )
+
+    capture = args.capture
+    if capture is None:
+        os.makedirs("bench_captures", exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        capture = os.path.join(
+            "bench_captures",
+            f"xor_ab_{_runlog.backend_name() or 'cpu'}_{stamp}.jsonl",
+        )
+    if capture != "-":
+        with open(capture, "w") as fp:
+            fp.write(json.dumps(_runlog.capture_header("xor_ab")) + "\n")
+            for row in rows:
+                fp.write(json.dumps(row) + "\n")
+        print(f"xor_ab: capture -> {capture}", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"rows": rows, "capture": capture}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,232 @@
+"""Per-backend GEMM-strategy autotuner — what ``strategy="auto"`` means.
+
+Before this module, ``auto`` was a hard-coded branch (pallas on real TPU
+hardware, bitplane elsewhere).  Now every ``auto`` resolution routes
+through here, where the XOR-lowered strategy (docs/XOR.md) competes
+against table/bitplane/pallas and the native host codec per backend:
+
+* **prior mode** (the default): zero-cost resolution from the static
+  per-backend ranking — identical behaviour to the old branch (pallas on
+  TPU, bitplane elsewhere) unless a MEASURED decision for this (backend,
+  k, p, w) class is already cached in-process, in which case the
+  measured winner is used.
+* **measure mode** (``RS_STRATEGY_AUTOTUNE=measure``): the first ``auto``
+  resolution per (backend, k, p, w) class times every candidate on a
+  synthetic encode-shaped stripe (warm-up pass absorbs compiles,
+  best-of-reps measured) and caches the winner for the process.  This is
+  seconds of one-time work per class — a resident daemon or bench run
+  opts in; one-shot CLI invocations keep the free prior.
+* ``RS_STRATEGY_AUTOTUNE=off``: always the static prior (escape hatch).
+
+Decisions are process-cached and surfaced via :func:`decisions` (the
+``rs doctor`` strategy section and ``rs stats`` read them).  Mesh
+dispatches never autotune: the mesh path supports a fixed strategy set
+and the collective executable is pinned by its own jit cache.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+import numpy as np
+
+__all__ = [
+    "VALID_STRATEGIES", "candidate_strategies", "resolve_auto",
+    "autotune_decision", "decisions", "clear_decisions", "mode",
+    "static_choice",
+]
+
+# Every strategy the codec accepts ("auto" resolves to one of the rest).
+VALID_STRATEGIES = ("auto", "bitplane", "table", "pallas", "xor", "cpu")
+
+_DECISIONS: dict[tuple, dict] = {}
+_LOCK = threading.Lock()
+_MEASURE_LOCK = threading.Lock()  # serializes candidate sweeps
+
+_MEASURE_COLS = 256 * 1024  # bytes per chunk in the probe stripe
+_MEASURE_REPS = 3
+
+
+def mode() -> str:
+    """``prior`` (default) | ``measure`` | ``off`` from the env knob."""
+    v = os.environ.get("RS_STRATEGY_AUTOTUNE", "prior").lower()
+    if v in ("measure", "1", "on"):
+        return "measure"
+    if v in ("off", "0", "false", "no"):
+        return "off"
+    return "prior"
+
+
+def _backend() -> str:
+    # Through the codec's module-level alias, which is the documented
+    # monkeypatch seam for steering strategy selection in tests.
+    from .codec import _tpu_devices_present
+
+    return "tpu" if _tpu_devices_present() else "other"
+
+
+def static_choice(w: int = 8) -> str:
+    """The zero-cost prior: the fused kernel on real TPU hardware (the
+    reference runs its fast kernel unconditionally, decode.cu:335-378),
+    the XLA bitplane path elsewhere."""
+    return "pallas" if _backend() == "tpu" else "bitplane"
+
+
+def candidate_strategies(w: int = 8, *, include_native: bool = True):
+    """Strategies ``auto`` may pick on this backend, fastest-prior first."""
+    if _backend() == "tpu":
+        cands = ["pallas", "bitplane", "xor", "table"]
+    else:
+        cands = ["bitplane", "xor", "table"]
+    if include_native and w == 8:
+        from . import native
+
+        if native.available():
+            cands.append("cpu")
+    return tuple(cands)
+
+
+def decisions() -> dict:
+    """Snapshot of cached autotune decisions (doctor/stats surface)."""
+    with _LOCK:
+        return {
+            "|".join(map(str, key)): dict(val)
+            for key, val in _DECISIONS.items()
+        }
+
+
+def clear_decisions() -> None:
+    with _LOCK:
+        _DECISIONS.clear()
+
+
+def _measure_one(strategy: str, A, B, w: int) -> float:
+    """Best-of-reps wall seconds for one warm strategy dispatch.
+
+    ``B`` arrives where the strategy actually reads it (host array for
+    the native codec, device array for the rest) so no arm's timed
+    region includes a transfer the production path never pays.
+    """
+    import jax
+
+    from .ops.gemm import gf_matmul_jit
+    from .ops.xor_gemm import gf_matmul_xor
+
+    if strategy == "cpu":
+        from . import native
+
+        Ah, Bh = np.asarray(A), np.asarray(B)
+
+        def run():
+            return native.gemm(Ah, Bh)
+
+    elif strategy == "xor":
+
+        def run():
+            return gf_matmul_xor(A, B, w)
+
+    elif strategy == "pallas":
+        from .ops.pallas_gemm import gf_matmul_pallas
+
+        def run():
+            return gf_matmul_pallas(A, B, w)
+
+    else:
+
+        def run():
+            return gf_matmul_jit(A, B, w=w, strategy=strategy)
+
+    jax.block_until_ready(run())  # absorb compiles
+    best = float("inf")
+    for _ in range(_MEASURE_REPS):
+        t0 = time.perf_counter()
+        jax.block_until_ready(run())
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def autotune_decision(k: int, p: int, w: int = 8,
+                      generator: str = "vandermonde") -> dict:
+    """Measure every candidate on an encode-shaped stripe and cache the
+    winner for this (backend, k, p, w) class.  Failing candidates (e.g.
+    pallas off-TPU) are excluded with their error class recorded."""
+    import jax
+
+    from .models.vandermonde import generator_matrix
+    from .ops.gf import get_field
+
+    backend = _backend()
+    key = (backend, k, p, w)
+    with _LOCK:
+        hit = _DECISIONS.get(key)
+    if hit is not None:
+        return hit
+    # One sweep at a time, re-checked under the lock: concurrent first
+    # resolutions of the same class (a daemon's worker pool) must not
+    # each burn a multi-second candidate sweep to discard all but one.
+    with _MEASURE_LOCK:
+        with _LOCK:
+            hit = _DECISIONS.get(key)
+        if hit is not None:
+            return hit
+        gf = get_field(w)
+        A = generator_matrix(generator, p, k, gf)
+        m = max(1, _MEASURE_COLS // int(np.dtype(gf.dtype).itemsize))
+        rng = np.random.default_rng(20260804)
+        Bh = rng.integers(0, gf.size, size=(k, m)).astype(gf.dtype)
+        Bd = jax.device_put(Bh)
+        table: dict[str, float | None] = {}
+        data_bytes = k * m * int(np.dtype(gf.dtype).itemsize)
+        best_name, best_gbps = None, -1.0
+        for name in candidate_strategies(w):
+            try:
+                dt = _measure_one(name, A, Bh if name == "cpu" else Bd, w)
+                gbps = data_bytes / dt / 1e9 if dt > 0 else 0.0
+                table[name] = round(gbps, 4)
+                if gbps > best_gbps:
+                    best_name, best_gbps = name, gbps
+            except Exception as e:  # candidate unsupported here: skip it
+                table[name] = None
+                table[f"{name}_error"] = type(e).__name__
+        if best_name is None:  # every candidate failed: keep the prior
+            best_name = static_choice(w)
+        decision = {
+            "strategy": best_name,
+            "source": "measured",
+            "backend": backend,
+            "k": k,
+            "p": p,
+            "w": w,
+            "gbps": table,
+            "ts": time.time(),
+        }
+        from .obs import metrics as _metrics
+
+        _metrics.counter(
+            "rs_strategy_autotune_total",
+            "strategy-autotune measurements by backend and winner",
+        ).labels(backend=backend, winner=best_name).inc()
+        with _LOCK:
+            return _DECISIONS.setdefault(key, decision)
+
+
+def resolve_auto(k: int, p: int, w: int = 8, *, mesh=None,
+                 generator: str = "vandermonde") -> str:
+    """Resolve ``strategy="auto"`` for a codec of this shape.
+
+    Mesh codecs and ``off`` mode take the static prior; otherwise a
+    cached measured decision wins, and ``measure`` mode creates one on
+    first use per (backend, k, p, w) class.
+    """
+    if mesh is not None or mode() == "off":
+        return static_choice(w)
+    backend = _backend()
+    with _LOCK:
+        hit = _DECISIONS.get((backend, k, p, w))
+    if hit is not None:
+        return hit["strategy"]
+    if mode() == "measure":
+        return autotune_decision(k, p, w, generator)["strategy"]
+    return static_choice(w)

@@ -198,8 +198,9 @@ def test_analyze_json_schema_and_strategies(analyze_report):
     assert {"roofline", "strategies", "latency", "config",
             "backend"} <= set(report)
     rows = {(r["strategy"], r["op"]) for r in report["strategies"]}
-    # The acceptance surface: table/bitplane/native, encode and decode.
-    for s in ("table", "bitplane", "native"):
+    # The acceptance surface: table/bitplane/xor/native, encode + decode
+    # (xor joined the default roofline workload with ISSUE 11).
+    for s in ("table", "bitplane", "xor", "native"):
         assert (s, "encode") in rows and (s, "decode") in rows
     for r in report["strategies"]:
         assert r["achieved_gbps"] > 0

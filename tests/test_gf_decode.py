@@ -189,7 +189,9 @@ def _rot(path, chunk_idx, positions):
 
 
 @pytest.mark.parametrize("w", [8, 16])
-@pytest.mark.parametrize("strategy", ["bitplane", "table", "pallas", "cpu"])
+@pytest.mark.parametrize(
+    "strategy", ["bitplane", "table", "pallas", "xor", "cpu"]
+)
 def test_scrub_syndrome_attributes_single_chunk_bitrot(tmp_path, w,
                                                        strategy):
     """The acceptance surface: seeded single-chunk bitrot WITHOUT CRCs is

@@ -12,6 +12,7 @@ repaired, never silently decoded wrong)."""
 import os
 
 import numpy as np
+import pytest
 
 from gpu_rscode_tpu.codec import RSCodec
 from gpu_rscode_tpu.ops.gf import get_field
@@ -148,7 +149,7 @@ def test_seeded_random_erasures_all_strategies_roundtrip():
         dec = codec.decode_matrix(surv)
         want = np.asarray(codec.decode(dec, code[surv]))
         np.testing.assert_array_equal(want, natives)
-        for strategy in ("bitplane", "table"):
+        for strategy in ("bitplane", "table", "xor"):
             got = np.asarray(
                 gf_matmul(dec, code[surv], strategy=strategy)
             )
@@ -284,8 +285,9 @@ def test_gf16_sampled_ops_match_bitwise_oracle():
 def test_all_strategies_agree_on_full_gf8_mul_table():
     """Every GEMM strategy computes the FULL 256x256 GF(2^8) product
     table bit-identically (the k=1 contraction makes the GEMM a pure
-    multiplier): table, bitplane, fused pallas (interpret mode) and the
-    native host codec all equal the oracle-verified log/exp table."""
+    multiplier): table, bitplane, fused pallas (interpret mode), the
+    XOR-lowered bitsliced path and the native host codec all equal the
+    oracle-verified log/exp table."""
     from gpu_rscode_tpu import native
     from gpu_rscode_tpu.ops.gemm import gf_matmul
 
@@ -299,11 +301,41 @@ def test_all_strategies_agree_on_full_gf8_mul_table():
         got = np.asarray(gf_matmul(a, b, w=8, strategy=strategy))
         np.testing.assert_array_equal(got, want, err_msg=strategy)
     np.testing.assert_array_equal(native.gemm(a, b), want)
+    # The xor strategy's exhaustive pass lives in
+    # test_xor_strategy_full_gf8_mul_table_exhaustive (slow: its
+    # value-baked schedules make a 256-row k=1 GEMM a 256-schedule
+    # compile); here it covers a sampled 32-value slab of the table.
+    rows = np.arange(37, 69, dtype=np.uint8).reshape(32, 1)
+    got = np.asarray(gf_matmul(rows, b, w=8, strategy="xor"))
+    np.testing.assert_array_equal(got, want[37:69], err_msg="xor slab")
+
+
+@pytest.mark.slow
+def test_xor_strategy_full_gf8_mul_table_exhaustive():
+    """The xor strategy computes the FULL 256x256 GF(2^8) product table
+    bit-identically (k=1 GEMM trick, slabbed: one XOR schedule is baked
+    per coefficient matrix, and schedule compile cost scales with output
+    rows — 8 slabs of 32 keep this exhaustive pass affordable).  Run by
+    the CI xor-smoke job."""
+    from gpu_rscode_tpu.ops.gemm import gf_matmul
+
+    b = np.arange(256, dtype=np.uint8).reshape(1, 256)
+    want = GF.mul(
+        np.arange(256, dtype=np.int64)[:, None],
+        np.arange(256, dtype=np.int64)[None, :],
+    ).astype(np.uint8)
+    for lo in range(0, 256, 32):
+        a = np.arange(lo, lo + 32, dtype=np.uint8).reshape(32, 1)
+        got = np.asarray(gf_matmul(a, b, w=8, strategy="xor"))
+        np.testing.assert_array_equal(
+            got, want[lo:lo + 32], err_msg=f"xor rows {lo}..{lo + 31}"
+        )
 
 
 def test_strategies_agree_sampled_gf16():
-    """Sampled GF(2^16) GEMMs: table, bitplane and pallas agree with the
-    host oracle (native is w=8-only by contract)."""
+    """Sampled GF(2^16) GEMMs: table, bitplane, pallas and the
+    XOR-lowered path agree with the host oracle (native is w=8-only by
+    contract)."""
     from gpu_rscode_tpu.ops.gemm import gf_matmul
 
     gf16 = get_field(16)
@@ -315,7 +347,7 @@ def test_strategies_agree_sampled_gf16():
         A = rng.integers(0, 1 << 16, size=(p, k), dtype=np.uint16)
         B = rng.integers(0, 1 << 16, size=(k, m), dtype=np.uint16)
         want = gf16.matmul(A, B)
-        for strategy in ("table", "bitplane", "pallas"):
+        for strategy in ("table", "bitplane", "pallas", "xor"):
             got = np.asarray(gf_matmul(A, B, w=16, strategy=strategy))
             np.testing.assert_array_equal(
                 got, want, err_msg=f"{strategy} ({p},{k},{m})"
@@ -347,7 +379,7 @@ def test_encode_linearity_across_strategies():
             E = rng.integers(0, hi, size=(p, k)).astype(dtype)
             a = rng.integers(0, hi, size=(k, m)).astype(dtype)
             b = rng.integers(0, hi, size=(k, m)).astype(dtype)
-            for strategy in ("table", "bitplane", "pallas"):
+            for strategy in ("table", "bitplane", "pallas", "xor"):
                 lhs = np.asarray(gf_matmul(E, a ^ b, w=w, strategy=strategy))
                 rhs = np.asarray(
                     gf_matmul(E, a, w=w, strategy=strategy)
@@ -388,7 +420,7 @@ def test_delta_parity_identity_across_strategies():
             parity_old = np.asarray(codec.encode(old))
             parity_new = np.asarray(codec.encode(new))
             delta = old ^ new
-            for strategy in ("table", "bitplane", "pallas"):
+            for strategy in ("table", "bitplane", "pallas", "xor"):
                 pd = np.asarray(gf_matmul(E, delta, w=w, strategy=strategy))
                 np.testing.assert_array_equal(
                     parity_old ^ pd, parity_new,

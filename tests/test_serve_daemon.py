@@ -407,7 +407,7 @@ def test_injected_faults_bounded_errors_no_wedge(tmp_path, monkeypatch):
 def test_warm_plan_resolves_and_caches():
     out = api.warm_plan(4, 2, w=8, file_bytes=65536)
     assert out["k"] == 4 and out["p"] == 2
-    assert out["strategy"] in ("bitplane", "pallas", "table", "cpu")
+    assert out["strategy"] in ("bitplane", "pallas", "table", "xor", "cpu")
     assert out["cols"] >= 1
     with pytest.raises(ValueError):
         api.warm_plan(4, 2, w=5)

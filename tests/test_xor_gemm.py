@@ -1,0 +1,471 @@
+"""strategy="xor" — XOR-lowered bitsliced GF GEMM (ops/xor_gemm.py,
+docs/XOR.md): pack/unpack transform soundness, schedule construction +
+Paar CSE, plan-cache digest keying, autotuner resolution, codec/CLI/file
+round trips and the doctor/bench surfaces."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import plan, tune
+from gpu_rscode_tpu.codec import RSCodec
+from gpu_rscode_tpu.ops import xor_gemm as xg
+from gpu_rscode_tpu.ops.gf import get_field
+from gpu_rscode_tpu.ops.xor_gemm import (
+    build_schedule,
+    gf_matmul_xor,
+    matrix_digest,
+)
+
+GF8 = get_field(8)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tune():
+    tune.clear_decisions()
+    yield
+    tune.clear_decisions()
+
+
+# ----- packed bit-plane transform ---------------------------------------------
+
+
+def test_pack_unpack_roundtrip_random():
+    import jax
+
+    rng = np.random.default_rng(0)
+    X = rng.integers(0, 256, size=(3, 256), dtype=np.uint8)
+
+    def rt(b):
+        planes = xg._pack_row(b, 8)
+        import jax.numpy as jnp
+        from jax import lax
+
+        pieces = xg._unpack_row_pieces(planes, 8)
+        return lax.bitcast_convert_type(
+            jnp.concatenate(pieces), jnp.uint8
+        ).reshape(-1)
+
+    for row in X:
+        back = np.asarray(jax.jit(rt)(row))
+        np.testing.assert_array_equal(back, row)
+
+
+def test_pack_plane_index_is_true_bit_number():
+    """A row of bytes with ONLY bit j set packs into plane j and no
+    other — the property the binary matrix's column indexing relies on."""
+    import jax
+
+    for j in range(8):
+        row = np.full(64, 1 << j, dtype=np.uint8)
+        planes = jax.jit(lambda b: xg._pack_row(b, 8))(row)
+        nz = [i for i in range(8) if np.asarray(planes[i]).any()]
+        assert nz == [j]
+        assert np.asarray(planes[j]).all()  # every bit of the plane set
+
+
+def test_pack_w16_planes_split_lo_hi():
+    import jax
+
+    for j in (0, 7, 8, 15):
+        row = np.full(64, 1 << j, dtype=np.uint16)
+        planes = jax.jit(lambda b: xg._pack_row(b, 16))(row)
+        nz = [i for i in range(16) if np.asarray(planes[i]).any()]
+        assert nz == [j]
+
+
+def test_swar_mapping_is_involution_exhaustive():
+    """The 8x8 SWAR transpose maps virtual-block bit (i, j) to lane
+    (j+4)%8, bit (i+4)%8 — exhaustively, and twice = identity."""
+    import jax
+    import jax.numpy as jnp
+
+    swar = jax.jit(lambda x, y: xg._swar_pair(x, y))
+    for i in range(8):
+        for j in range(8):
+            blk = np.zeros(8, dtype=np.uint8)
+            blk[i] = np.uint8(1 << j)
+            w = blk.view(np.uint32)
+            x, y = swar(jnp.asarray(w[0:1]), jnp.asarray(w[1:2]))
+            out = np.concatenate(
+                [np.asarray(x), np.asarray(y)]
+            ).view(np.uint8)
+            pos = [(L, b) for L in range(8) for b in range(8)
+                   if (out[L] >> b) & 1]
+            assert pos == [((j + 4) % 8, (i + 4) % 8)], (i, j)
+            x2, y2 = swar(x, y)
+            back = np.concatenate(
+                [np.asarray(x2), np.asarray(y2)]
+            ).view(np.uint8)
+            np.testing.assert_array_equal(back, blk)
+
+
+# ----- schedule construction + CSE --------------------------------------------
+
+
+def test_schedule_cse_reduces_terms_and_matches_naive():
+    A = np.asarray(
+        np.random.default_rng(1).integers(0, 256, size=(4, 10)),
+        dtype=np.uint8,
+    )
+    s_cse = build_schedule(A, 8, cse=True)
+    s_naive = build_schedule(A, 8, cse=False)
+    assert s_cse.digest == s_naive.digest == matrix_digest(A, 8)
+    assert s_naive.pair_ops == ()
+    assert s_cse.pair_ops  # a dense random matrix always shares pairs
+    assert s_cse.xors < s_naive.xors
+    # both schedules compute the same product
+    B = np.random.default_rng(2).integers(
+        0, 256, size=(10, 96), dtype=np.uint8
+    )
+    want = GF8.matmul(A, B)
+    for sched in (s_cse, s_naive):
+        pipe = xg.XorPipeline(sched, 10, 96, np.uint8)
+        np.testing.assert_array_equal(np.asarray(pipe(A, B)), want)
+
+
+def test_schedule_cached_by_digest():
+    A = np.arange(8, dtype=np.uint8).reshape(2, 4) + 1
+    assert build_schedule(A, 8) is build_schedule(A.copy(), 8)
+    A2 = A.copy()
+    A2[0, 0] ^= 0xFF
+    assert matrix_digest(A, 8) != matrix_digest(A2, 8)
+
+
+def test_schedule_rejects_oversized_matrices(monkeypatch):
+    monkeypatch.setenv("RS_XOR_MAX_TERMS", "10")
+    xg.clear_pipeline_cache()  # schedules cache by digest, not knob
+    A = np.full((4, 8), 7, dtype=np.uint8)
+    with pytest.raises(ValueError, match="RS_XOR_MAX_TERMS"):
+        build_schedule(A, 8)
+
+
+def test_unsupported_width_rejected():
+    with pytest.raises(ValueError, match="w in"):
+        build_schedule(np.ones((2, 2), dtype=np.uint8), 4)
+
+
+# ----- GEMM equivalence (compact; the full axes live in test_property) --------
+
+
+def test_gf_matmul_xor_matches_oracle_both_widths():
+    rng = np.random.default_rng(3)
+    for w in (8, 16):
+        gf = get_field(w)
+        dt = np.uint8 if w == 8 else np.uint16
+        for (p, k, m) in [(3, 5, 101), (1, 1, 1), (2, 4, 32)]:
+            A = rng.integers(0, gf.size, size=(p, k)).astype(dt)
+            B = rng.integers(0, gf.size, size=(k, m)).astype(dt)
+            got = np.asarray(gf_matmul_xor(A, B, w))
+            assert got.dtype == dt
+            np.testing.assert_array_equal(got, gf.matmul(A, B))
+
+
+def test_zero_coefficient_row_yields_zero_output():
+    A = np.zeros((2, 3), dtype=np.uint8)
+    A[1] = 5
+    B = np.random.default_rng(4).integers(
+        0, 256, size=(3, 50), dtype=np.uint8
+    )
+    got = np.asarray(gf_matmul_xor(A, B, 8))
+    assert not got[0].any()
+    np.testing.assert_array_equal(got, GF8.matmul(A, B))
+
+
+def test_traced_data_operand_works_under_jit():
+    import jax
+
+    A = np.asarray([[1, 2], [3, 4]], dtype=np.uint8)
+    B = np.random.default_rng(5).integers(
+        0, 256, size=(2, 40), dtype=np.uint8
+    )
+    got = np.asarray(jax.jit(lambda b: gf_matmul_xor(A, b, 8))(B))
+    np.testing.assert_array_equal(got, GF8.matmul(A, B))
+
+
+def test_traced_coefficients_raise_actionable_error():
+    import jax
+
+    B = np.zeros((2, 32), dtype=np.uint8)
+    with pytest.raises(TypeError, match="concrete coefficient"):
+        jax.jit(lambda a: gf_matmul_xor(a, B, 8))(
+            np.ones((2, 2), dtype=np.uint8)
+        )
+
+
+# ----- plan-cache integration -------------------------------------------------
+
+
+def test_plan_cache_one_schedule_per_matrix_digest():
+    plan.PLAN_CACHE.clear()
+    codec = RSCodec(4, 2, strategy="xor")
+    B = np.random.default_rng(6).integers(
+        0, 256, size=(4, 640), dtype=np.uint8
+    )
+    for _ in range(4):
+        codec.encode(B)
+    xor_plans = [
+        pl for pl in plan.PLAN_CACHE.stats()["plans"]
+        if pl["strategy"] == "xor"
+    ]
+    assert len(xor_plans) == 1, "one plan per digest, not per dispatch"
+    assert xor_plans[0]["calls"] == 4
+    assert xor_plans[0]["xor"]["terms_naive"] >= xor_plans[0]["xor"]["xors"]
+    assert xor_plans[0]["xor"]["digest"] == matrix_digest(
+        codec.parity_block, 8
+    )
+    # same shape, different coefficients -> a second plan (digest key)
+    codec2 = RSCodec(4, 2, strategy="xor", generator="cauchy")
+    codec2.encode(B)
+    xor_plans = [
+        pl for pl in plan.PLAN_CACHE.stats()["plans"]
+        if pl["strategy"] == "xor"
+    ]
+    assert len(xor_plans) == 2
+
+
+def test_plan_clear_drops_xor_pipelines():
+    codec = RSCodec(3, 2, strategy="xor")
+    codec.encode(np.zeros((3, 64), dtype=np.uint8))
+    assert xg.pipeline_stats()
+    plan.PLAN_CACHE.clear()
+    assert not xg.pipeline_stats()
+    assert not xg.schedule_stats()
+
+
+def test_update_rides_the_encode_plan_class():
+    """op="update" dispatches the SAME (p, k) matrix as encode: one xor
+    plan serves both (the op-free plan key contract, docs/PLAN.md)."""
+    plan.PLAN_CACHE.clear()
+    codec = RSCodec(4, 2, strategy="xor")
+    B = np.random.default_rng(7).integers(
+        0, 256, size=(4, 640), dtype=np.uint8
+    )
+    codec.encode(B)
+    codec.update(codec.parity_block, B)
+    xor_plans = [
+        pl for pl in plan.PLAN_CACHE.stats()["plans"]
+        if pl["strategy"] == "xor"
+    ]
+    assert len(xor_plans) == 1 and xor_plans[0]["calls"] == 2
+
+
+# ----- codec validation + ops -------------------------------------------------
+
+
+def test_unknown_strategy_enumerates_valid_ones():
+    with pytest.raises(ValueError) as ei:
+        RSCodec(4, 2, strategy="warp")
+    msg = str(ei.value)
+    for name in tune.VALID_STRATEGIES:
+        assert name in msg
+
+
+def test_xor_rejects_mesh_and_w4():
+    with pytest.raises(ValueError, match="GF\\(2\\^8\\) and GF\\(2\\^16\\)"):
+        RSCodec(4, 2, w=4, strategy="xor")
+
+    class FakeMesh:
+        pass
+
+    with pytest.raises(ValueError, match="single-device"):
+        RSCodec(4, 2, strategy="xor", mesh=FakeMesh())
+
+
+def test_codec_all_four_ops_match_reference_strategy():
+    rng = np.random.default_rng(8)
+    for w in (8, 16):
+        gf = get_field(w)
+        dt = np.uint8 if w == 8 else np.uint16
+        k, p, m = 5, 3, 200
+        cx = RSCodec(k, p, w=w, strategy="xor", generator="cauchy")
+        ct = RSCodec(k, p, w=w, strategy="table", generator="cauchy")
+        B = rng.integers(0, gf.size, size=(k, m)).astype(dt)
+        par = np.asarray(cx.encode(B))
+        np.testing.assert_array_equal(par, np.asarray(ct.encode(B)))
+        code = np.concatenate([B, par], axis=0)
+        surv = list(rng.permutation(k + p)[:k])
+        dec = cx.decode_matrix(surv)
+        np.testing.assert_array_equal(
+            np.asarray(cx.decode(dec, code[surv])), B
+        )
+        delta = rng.integers(0, gf.size, size=(k, m)).astype(dt)
+        np.testing.assert_array_equal(
+            np.asarray(cx.update(cx.parity_block, delta)),
+            np.asarray(ct.update(ct.parity_block, delta)),
+        )
+        H = rng.integers(0, gf.size, size=(p, k + p)).astype(dt)
+        np.testing.assert_array_equal(
+            np.asarray(cx.syndrome(H, code)),
+            np.asarray(ct.syndrome(H, code)),
+        )
+
+
+# ----- autotuner --------------------------------------------------------------
+
+
+def test_auto_candidates_include_xor():
+    assert "xor" in tune.candidate_strategies(8)
+    assert "xor" in tune.candidate_strategies(16)
+
+
+def test_auto_prior_mode_keeps_legacy_choice(monkeypatch):
+    monkeypatch.delenv("RS_STRATEGY_AUTOTUNE", raising=False)
+    assert tune.mode() == "prior"
+    assert RSCodec(4, 2, strategy="auto").strategy == tune.static_choice()
+    monkeypatch.setenv("RS_STRATEGY_AUTOTUNE", "off")
+    assert RSCodec(4, 2, strategy="auto").strategy == tune.static_choice()
+
+
+def test_auto_measure_mode_picks_measured_winner(monkeypatch):
+    monkeypatch.setenv("RS_STRATEGY_AUTOTUNE", "measure")
+    fake = {"xor": 0.001, "table": 0.5, "bitplane": 0.5, "cpu": 0.5,
+            "pallas": 0.5}
+    calls = []
+
+    def fake_measure(strategy, A, B, w):
+        calls.append(strategy)
+        return fake[strategy]
+
+    monkeypatch.setattr(tune, "_measure_one", fake_measure)
+    codec = RSCodec(6, 3, strategy="auto")
+    assert codec.strategy == "xor"
+    n_measured = len(calls)
+    assert n_measured >= 3
+    # cached: a second codec of the same class re-measures nothing
+    assert RSCodec(6, 3, strategy="auto").strategy == "xor"
+    assert len(calls) == n_measured
+    key = next(iter(tune.decisions()))
+    assert tune.decisions()[key]["source"] == "measured"
+
+
+def test_auto_measure_mode_survives_failing_candidates(monkeypatch):
+    monkeypatch.setenv("RS_STRATEGY_AUTOTUNE", "measure")
+
+    def fake_measure(strategy, A, B, w):
+        if strategy != "table":
+            raise RuntimeError("boom")
+        return 0.01
+
+    monkeypatch.setattr(tune, "_measure_one", fake_measure)
+    assert RSCodec(5, 2, strategy="auto").strategy == "table"
+    decision = next(iter(tune.decisions().values()))
+    assert decision["gbps"]["bitplane"] is None
+    assert decision["gbps"]["bitplane_error"] == "RuntimeError"
+
+
+def test_mesh_auto_never_measures(monkeypatch):
+    monkeypatch.setenv("RS_STRATEGY_AUTOTUNE", "measure")
+    monkeypatch.setattr(
+        tune, "_measure_one",
+        lambda *a: (_ for _ in ()).throw(AssertionError("measured")),
+    )
+    assert tune.resolve_auto(4, 2, 8, mesh=object()) == \
+        tune.static_choice()
+
+
+# ----- file-level round trip --------------------------------------------------
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_encode_decode_file_with_xor(tmp_path, w):
+    from gpu_rscode_tpu import api
+    from gpu_rscode_tpu.tools.make_conf import make_conf
+
+    rng = np.random.default_rng(9)
+    path = str(tmp_path / f"xor_{w}.bin")
+    data = rng.integers(0, 256, size=50000, dtype=np.uint8).tobytes()
+    open(path, "wb").write(data)
+    api.encode_file(path, 4, 2, w=w, strategy="xor", segment_bytes=16384,
+                    checksums=True)
+    conf = make_conf(6, 4, path)
+    out = api.decode_file(path, conf, path + ".dec", strategy="xor",
+                          segment_bytes=16384)
+    assert open(out, "rb").read() == data
+
+
+def test_update_file_with_xor(tmp_path):
+    from gpu_rscode_tpu import api
+
+    rng = np.random.default_rng(10)
+    path = str(tmp_path / "up.bin")
+    data = bytearray(rng.integers(0, 256, size=30000, dtype=np.uint8))
+    open(path, "wb").write(bytes(data))
+    api.encode_file(path, 4, 2, strategy="xor", segment_bytes=8192,
+                    checksums=True)
+    delta = rng.integers(0, 256, size=500, dtype=np.uint8).tobytes()
+    api.update_file(path, 1234, delta, strategy="xor",
+                    segment_bytes=8192)
+    data[1234:1234 + 500] = delta
+    out = api.auto_decode_file(path, path + ".dec", strategy="xor",
+                               segment_bytes=8192)
+    assert open(out, "rb").read() == bytes(data)
+
+
+# ----- CLI / doctor / tool surfaces ------------------------------------------
+
+
+def test_cli_rejects_unknown_strategy(tmp_path, capsys):
+    from gpu_rscode_tpu import cli
+
+    f = tmp_path / "x.bin"
+    f.write_bytes(b"payload")
+    assert cli.main(["-k", "2", "-n", "4", "-e", str(f),
+                     "--strategy", "warp"]) == 2
+    err = capsys.readouterr().err
+    assert "unknown --strategy" in err and "xor" in err
+
+
+def test_cli_encode_decode_with_xor(tmp_path, capsys):
+    from gpu_rscode_tpu import cli
+
+    f = tmp_path / "c.bin"
+    f.write_bytes(os.urandom(5000))
+    want = f.read_bytes()
+    assert cli.main(["-k", "3", "-n", "5", "-e", str(f),
+                     "--strategy", "xor", "--quiet"]) == 0
+    os.unlink(f)
+    assert cli.main(["-d", "--auto", "-i", str(f), "--strategy", "xor",
+                     "--quiet"]) == 0
+    assert f.read_bytes() == want
+
+
+def test_doctor_strategies_section(capsys):
+    from gpu_rscode_tpu import cli
+
+    # ensure at least one schedule is cached so the stats surface fills
+    gf_matmul_xor(np.asarray([[3, 1]], dtype=np.uint8),
+                  np.zeros((2, 32), dtype=np.uint8), 8)
+    assert cli.main(["doctor", "--json", "--no-probe"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    sec = report["strategies"]
+    assert sec["error"] is None
+    assert "xor" in sec["candidates"]
+    assert sec["auto"]["strategy"] in tune.VALID_STRATEGIES
+    assert sec["auto"]["mode"] in ("prior", "measure", "off")
+    assert sec["xor"]["supported_w"] == [8, 16]
+    assert sec["xor"]["schedules"], "cached schedules must surface"
+    row = sec["xor"]["schedules"][0]
+    assert {"digest", "terms_naive", "terms_cse", "xors"} <= set(row)
+
+
+def test_xor_ab_tool_capture_schema(tmp_path, capsys):
+    from gpu_rscode_tpu.tools import xor_ab
+
+    cap = str(tmp_path / "xor_ab.jsonl")
+    rc = xor_ab.main([
+        "--ab", "--size-mb", "0.2", "--trials", "1",
+        "--capture", cap, "--json",
+    ])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    row = out["rows"][0]
+    assert row["kind"] == "xor_ab" and row["op"] == "encode"
+    assert row["gbps"]["xor"] > 0 and row["gbps"]["table"] > 0
+    assert row["xor_over_table"] > 0
+    lines = open(cap).read().splitlines()
+    header = json.loads(lines[0])
+    assert header["tool"] == "xor_ab"
+    assert json.loads(lines[1])["kind"] == "xor_ab"
