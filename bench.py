@@ -587,7 +587,17 @@ def main() -> None:
     else:
         sample_by_name = {}
     data_bytes = K * m
-    detail = {}
+    # Parallelism identity (the ROADMAP's multi-core XLA scaling claim
+    # needs the cores each number was measured on — see
+    # obs/runlog.capture_header, which records the same fields for every
+    # tools/* capture): physical CPUs and the affinity-limited intra-op
+    # thread count XLA CPU can actually use.
+    from gpu_rscode_tpu.obs import runlog as _runlog_mod
+
+    detail = {
+        "host_cpus": os.cpu_count() or 1,
+        "intra_op_threads": _runlog_mod.intra_op_threads(),
+    }
     best = (None, 0.0)
     global _PARTIAL
     for name, fn in candidates:

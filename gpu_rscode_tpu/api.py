@@ -29,6 +29,7 @@ import time
 
 import numpy as np
 
+from collections import OrderedDict
 from contextlib import contextmanager, nullcontext
 
 from .codec import RSCodec
@@ -2181,6 +2182,97 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
         )
 
 
+# -- generation-keyed survivor-subset cache -----------------------------------
+#
+# Decode-side warm-path amortization (docs/PLAN.md "Generation-keyed
+# schedule entries"): every auto-decode attempt, scrub verdict and
+# repair pass used to re-run the subset search and re-invert the k x k
+# submatrix — and, under ``strategy="xor"``, every DISTINCT survivor
+# subset compiles its own inverse schedule.  This cache pins one chosen
+# subset + verified inverse per (archive, generation): subset churn
+# (different parity chunks dying and coming back, natives reappearing,
+# fleet re-passes) keeps resolving to the pinned subset as long as it is
+# still fully healthy, so the xor schedule for its inverse compiles
+# exactly once per archive generation.  An update/append bumps the
+# metadata generation and invalidates the entry; a total-matrix change
+# (re-encode under the same name, different generator) is caught by the
+# matrix digest.  ``PLAN_CACHE.clear()`` clears this too — the pinned
+# inverse's schedule lives in the caches that clear drops.
+
+_SUBSET_CACHE: "OrderedDict[str, dict]" = OrderedDict()
+_SUBSET_LOCK = threading.Lock()
+_SUBSET_CACHE_MAX = 128
+_SUBSET_STATS = {"hits": 0, "misses": 0, "stale": 0}
+
+
+def clear_subset_cache() -> None:
+    """Drop the generation-keyed survivor-subset cache (paired with
+    ``PLAN_CACHE.clear()``; stats reset too)."""
+    with _SUBSET_LOCK:
+        _SUBSET_CACHE.clear()
+        for key in _SUBSET_STATS:
+            _SUBSET_STATS[key] = 0
+
+
+def subset_cache_stats() -> dict:
+    """Doctor surface: entry count + this process's hit/miss/stale
+    tallies (``rs doctor`` strategies section)."""
+    with _SUBSET_LOCK:
+        return {"entries": len(_SUBSET_CACHE), **_SUBSET_STATS}
+
+
+def _subset_mat_digest(scan: _ChunkScan) -> str:
+    from .ops.xor_gemm import matrix_digest
+
+    return matrix_digest(scan.total_mat, scan.w)
+
+
+def _cached_subset(scan: _ChunkScan):
+    """The pinned (chosen, inverse) for this archive generation, or None
+    when absent, generation-stale, matrix-mismatched, or no longer fully
+    healthy in this scan."""
+    key = os.path.abspath(scan.in_file)
+    with _SUBSET_LOCK:
+        ent = _SUBSET_CACHE.get(key)
+    if ent is None:
+        return None
+    if (
+        ent["generation"] != scan.generation
+        or ent["mat_digest"] != _subset_mat_digest(scan)
+        or len(ent["chosen"]) != scan.k
+    ):
+        with _SUBSET_LOCK:
+            if _SUBSET_CACHE.get(key) is ent:
+                del _SUBSET_CACHE[key]
+            _SUBSET_STATS["stale"] += 1
+        return None
+    if not set(ent["chosen"]) <= set(scan.healthy):
+        # Not stale — the pinned subset just isn't available under THIS
+        # scan's damage; a later scan with those chunks back reuses it.
+        return None
+    with _SUBSET_LOCK:
+        if key in _SUBSET_CACHE:
+            _SUBSET_CACHE.move_to_end(key)
+        _SUBSET_STATS["hits"] += 1
+    return list(ent["chosen"]), ent["inv"]
+
+
+def _remember_subset(scan: _ChunkScan, chosen, inv) -> None:
+    key = os.path.abspath(scan.in_file)
+    ent = {
+        "generation": scan.generation,
+        "mat_digest": _subset_mat_digest(scan),
+        "chosen": tuple(int(c) for c in chosen),
+        "inv": inv,
+    }
+    with _SUBSET_LOCK:
+        _SUBSET_CACHE[key] = ent
+        _SUBSET_CACHE.move_to_end(key)
+        while len(_SUBSET_CACHE) > _SUBSET_CACHE_MAX:
+            _SUBSET_CACHE.popitem(last=False)
+        _SUBSET_STATS["misses"] += 1
+
+
 def _select_decodable_subset(scan: _ChunkScan, *, cap: int = 100,
                              skip: int = 0):
     """Pick k healthy chunk indices whose submatrix inverts; returns
@@ -2195,6 +2287,12 @@ def _select_decodable_subset(scan: _ChunkScan, *, cap: int = 100,
     :class:`UndecidedSubsetError` can continue the search where the last
     batch stopped (:func:`_select_subset_retrying`) instead of redoing —
     and then abandoning — the same ``cap`` singular candidates.
+
+    A fresh-window call (``skip == 0``) first consults the
+    generation-keyed subset cache: the archive's pinned subset — still
+    fully healthy under this scan, same generation, same matrix — comes
+    back with zero search, zero inversion and (under ``strategy="xor"``)
+    zero new schedule compiles.
     """
     from itertools import combinations
 
@@ -2207,6 +2305,10 @@ def _select_decodable_subset(scan: _ChunkScan, *, cap: int = 100,
             f"only {len(scan.healthy)} healthy chunks of the k={k} needed "
             f"(corrupt: {sorted(scan.bad)}, missing: {scan.missing})"
         )
+    if skip == 0:
+        hit = _cached_subset(scan)
+        if hit is not None:
+            return hit
     gf = get_field(scan.w)
     mat = scan.total_mat.astype(gf.dtype)
     capped = False
@@ -2218,6 +2320,7 @@ def _select_decodable_subset(scan: _ChunkScan, *, cap: int = 100,
             break
         try:
             inv = invert_matrix(mat[list(subset)], gf)
+            _remember_subset(scan, subset, inv)
             return list(subset), inv
         except SingularMatrixError:
             continue
@@ -2524,23 +2627,32 @@ def _count_located(n: int, w: int) -> None:
         ).labels(w=w).inc(n)
 
 
-def _locate_segment_fixes(ctx, codec, seg, seg_cols, sym, off, cols, timer):
+def _locate_segment_fixes(ctx, codec, seg, seg_cols, sym, off, cols, timer,
+                          want_packed: bool = False):
     """One segment's syndrome check: dispatch S = check @ seg through the
-    plan cache, locate on host, return the verified corrections dict
-    (column -> [(chunk, magnitude)]).  Raises gf_decode.UnlocatableError
-    past the t bound (counted before it propagates)."""
+    plan cache, locate on host, return ``(fixes, packed)`` — the verified
+    corrections dict (column -> [(chunk, magnitude)]) plus, with
+    ``want_packed`` under ``strategy="xor"``, the segment's
+    :class:`..ops.xor_gemm.PackedOperand` so the caller's recovery GEMM
+    reuses the pack stage this syndrome dispatch already paid
+    (docs/XOR.md "Packed-operand reuse"; None otherwise).  Raises
+    gf_decode.UnlocatableError past the t bound (counted before it
+    propagates)."""
     from .gf_decode import UnlocatableError
 
     if ctx.r == 0:
         _count_syndrome_verdict("no_headroom")
-        return {}
+        return {}, None
     with timer.phase("syndrome dispatch"), _dispatch_span(
         "syndrome", off, cols
     ):
         staged = codec.stage_segment(
             seg, cap=seg_cols // sym, sym=sym, out_rows=ctx.r
         )
-        S = codec.syndrome(ctx.check, staged)  # async
+        packed = codec.pack_operand(staged) if want_packed else None
+        S = codec.syndrome(
+            ctx.check, packed if packed is not None else staged
+        )  # async
     with timer.phase("syndrome locate"):
         S_np = np.asarray(S).astype(np.int64)
         try:
@@ -2550,7 +2662,7 @@ def _locate_segment_fixes(ctx, codec, seg, seg_cols, sym, off, cols, timer):
             raise
     _count_syndrome_verdict("silent_bitrot" if fixes else "clean")
     _count_located(sum(len(v) for v in fixes.values()), ctx.w)
-    return fixes
+    return fixes, packed
 
 
 def _syndrome_sweep(
@@ -2605,7 +2717,7 @@ def _syndrome_sweep(
         ) as prefetch:
             for (off, cols), seg in prefetch:
                 try:
-                    fixes = _locate_segment_fixes(
+                    fixes, _ = _locate_segment_fixes(
                         ctx, codec, seg, seg_cols, sym, off, cols, timer
                     )
                 except UnlocatableError:
@@ -2755,23 +2867,40 @@ def locate_decode_file(
             _segment_spans(chunk, seg_cols), stage, depth=pipeline_depth
         ) as prefetch:
             for (off, cols), seg in prefetch:
-                fixes = _locate_segment_fixes(
-                    ctx, codec, seg, seg_cols, sym, off, cols, timer
+                # Packed-domain reuse (docs/XOR.md): under strategy="xor"
+                # the syndrome dispatch packs the full survivor stack
+                # into bit-planes; the recovery GEMM below consumes the
+                # SAME rows, so it selects its survivor subset's planes
+                # from the returned handle instead of round-tripping
+                # through byte domain and re-packing — the pack stage
+                # (~60% of xor wall) runs once per segment, not twice.
+                fixes, packed = _locate_segment_fixes(
+                    ctx, codec, seg, seg_cols, sym, off, cols, timer,
+                    want_packed=dec_missing is not None,
                 )
                 if fixes:
                     segv = seg.view(np.uint16) if sym == 2 else seg
                     correct_segment(segv, fixes, row_of)
+                    # The planes pre-date the in-place patch: a corrected
+                    # segment re-stages below so the recovery GEMM reads
+                    # the patched bytes, never the stale planes.
+                    packed = None
                 rec_np = None
                 if dec_missing is not None:
                     with timer.phase("locate dispatch"), _dispatch_span(
                         "decode", off, cols
                     ):
-                        staged = codec.stage_segment(
-                            np.ascontiguousarray(seg[chosen_rows]),
-                            cap=seg_cols // sym, sym=sym,
-                            out_rows=dec_missing.shape[0],
-                        )
-                        rec = codec.decode(dec_missing, staged)
+                        if packed is not None:
+                            rec = codec.decode(
+                                dec_missing, packed.select(chosen_rows)
+                            )
+                        else:
+                            staged = codec.stage_segment(
+                                np.ascontiguousarray(seg[chosen_rows]),
+                                cap=seg_cols // sym, sym=sym,
+                                out_rows=dec_missing.shape[0],
+                            )
+                            rec = codec.decode(dec_missing, staged)
                     with timer.phase("decode compute"):
                         rec_np = np.asarray(rec)
                     if rec_np.dtype != np.uint8:
@@ -3312,6 +3441,13 @@ def repair_fleet(
                 f"(corrupt: {sorted(s.bad)}, missing: {s.missing})"
             )
             continue
+        # Re-pass reuse: a fleet sweeping the same archives (the scrub ->
+        # repair loop) skips the batched inversion dispatch for every
+        # archive whose pinned subset is still healthy at this generation.
+        hit = _cached_subset(s)
+        if hit is not None:
+            chosen_inv[f] = hit
+            continue
         groups.setdefault((s.k, s.w), []).append(f)
     with timer.phase("invert matrices (batched)"):
         from .utils.backend import tpu_devices_present
@@ -3357,6 +3493,7 @@ def repair_fleet(
                 )
                 if verified:
                     chosen_inv[f] = (ordered[f], invs[j])
+                    _remember_subset(scans[f], ordered[f], invs[j])
                     continue
                 # Singular first candidate (or a device-inverse mismatch —
                 # never observed, but a wrong inverse must not write wrong

@@ -186,8 +186,14 @@ class RSCodec:
         # GB/s.  True (pre-pad) columns for pipeline-staged segments —
         # bucket pad is compute, not payload.
         from . import plan as _plan
+        from .ops.xor_gemm import PackedOperand
 
-        if isinstance(data, _plan.StagedSegment):
+        if isinstance(data, PackedOperand):
+            # True-column payload of the symbols the planes encode —
+            # the pack pad is compute, not payload (same contract as
+            # the staged branch below).
+            nbytes = data.rows * data.cols_true * data.dtype.itemsize
+        elif isinstance(data, _plan.StagedSegment):
             nbytes = (
                 data.array.shape[0] * data.cols * data.array.dtype.itemsize
             )
@@ -279,9 +285,62 @@ class RSCodec:
             retain_host=out_rows is None or out_rows == seg.shape[0],
         )
 
+    def pack_operand(self, data):
+        """Pack a staged segment's bit-planes ONCE for reuse across the
+        chained xor dispatches that consume the same ``B`` operand
+        (docs/XOR.md "Packed-operand reuse"): the returned
+        :class:`..ops.xor_gemm.PackedOperand` feeds
+        :meth:`syndrome`/:meth:`decode` in place of the segment, and its
+        :meth:`~..ops.xor_gemm.PackedOperand.select` hands a row subset
+        to a follow-up dispatch with no second pack.  Returns ``None``
+        whenever the reuse does not apply — non-xor strategy, mesh
+        codec, plan layer off, ``RS_XOR_PACK_REUSE=0``, or a traced
+        operand — so callers can fall back to the classic path with one
+        ``is None`` check."""
+        from . import plan as _plan
+        from .ops import xor_gemm as _xg
+
+        if (
+            self.strategy != "xor"
+            or self.mesh is not None
+            or not _xg.pack_reuse_enabled()
+            or not _plan.enabled()
+        ):
+            return None
+        seg = data if isinstance(data, _plan.StagedSegment) else None
+        arr = seg.array if seg is not None else data
+        if isinstance(arr, jax.core.Tracer):
+            return None
+        cols_true = seg.cols if seg is not None else arr.shape[1]
+        cap = seg.cap if seg is not None else None
+        cols32 = _xg.padded_cols(arr.shape[1])
+        if arr.shape[1] != cols32:
+            # Ragged staged width (cap smaller than the pack alignment):
+            # pad exactly as plan.dispatch would before the pipeline.
+            import jax.numpy as jnp
+
+            arr = jnp.pad(
+                jnp.asarray(arr), ((0, 0), (0, cols32 - arr.shape[1]))
+            )
+        return _xg.pack_operand(arr, self.w, cols_true=cols_true, cap=cap)
+
     def _matmul(self, A, B):
         from . import plan as _plan
+        from .ops.xor_gemm import PackedOperand
 
+        if isinstance(B, PackedOperand):
+            # A pre-packed plane handle (see pack_operand): only the xor
+            # single-device plan path can consume it, and it is already
+            # bucket-padded — dispatch directly, trimming to true cols.
+            if self.strategy != "xor" or self.mesh is not None:
+                raise ValueError(
+                    "packed operands require strategy='xor' on a "
+                    "single-device codec"
+                )
+            return _plan.dispatch(
+                A, B, w=self.w, strategy="xor", cap=B.cap,
+                cols=B.cols_true,
+            )
         seg = B if isinstance(B, _plan.StagedSegment) else None
         staged = seg is not None
         b_cols = seg.cols if staged else None

@@ -182,8 +182,10 @@ def _update_section() -> dict:
 def _strategies_section() -> dict:
     """GEMM-strategy capability matrix (schema-stable): which strategies
     this build offers, what ``auto`` resolves to on this backend (the
-    autotuner verdict — docs/XOR.md), and the cached XOR-schedule stats
-    (term counts before/after CSE) so plan-cache bloat is visible."""
+    autotuner verdict — docs/XOR.md), the cached XOR-schedule stats
+    (term counts before/after CSE) so plan-cache bloat is visible, the
+    persistent schedule/autotune store facts, and the generation-keyed
+    survivor-subset cache tallies."""
     out: dict = {
         "valid": [],
         "candidates": [],
@@ -195,6 +197,19 @@ def _strategies_section() -> dict:
             "pipelines": 0,
         },
         "autotune_decisions": {},
+        "store": {
+            "path": None,
+            "enabled": False,
+            "entries": None,
+            "hits": 0,
+            "misses": 0,
+            "stored": 0,
+            "corrupt": 0,
+            "built": 0,
+            "ledger_autotune": 0,
+        },
+        "inverse_cache": {"entries": 0, "hits": 0, "misses": 0,
+                          "stale": 0},
         "error": None,
     }
     try:
@@ -206,15 +221,21 @@ def _strategies_section() -> dict:
         mode = _tune.mode()
         decisions = _tune.decisions()
         # The verdict an auto codec gets today, mirroring resolve_auto:
-        # `off` mode ignores the cache; measured decisions are per
-        # (k, p, w) class, so a unanimous winner reports as measured and
-        # split winners fall back to the prior label with the per-class
-        # table below telling the full story.
+        # `off` mode ignores the cache; measured/ledger decisions are per
+        # (k, p, w) class, so a unanimous winner reports with its source
+        # and split winners fall back to the prior label with the
+        # per-class table below telling the full story.
         winners = sorted({d["strategy"] for d in decisions.values()})
+        sources = sorted({
+            d.get("source") or "measured" for d in decisions.values()
+        })
         if mode == "off" or not winners:
             auto = {"strategy": _tune.static_choice(), "source": "prior"}
         elif len(winners) == 1:
-            auto = {"strategy": winners[0], "source": "measured"}
+            auto = {
+                "strategy": winners[0],
+                "source": sources[0] if len(sources) == 1 else "mixed",
+            }
         else:
             auto = {"strategy": _tune.static_choice(), "source": "mixed"}
         out["auto"] = dict(auto, mode=mode)
@@ -223,6 +244,18 @@ def _strategies_section() -> dict:
         out["xor"]["schedules"] = scheds
         out["xor"]["pipelines"] = len(_xg.pipeline_stats())
         out["autotune_decisions"] = decisions
+        # Persistent-store facts (docs/XOR.md "The persistent store"):
+        # resolved path, on-disk schedule entries (load=True forces one
+        # index read — doctor is a diagnostic, the parse is the point),
+        # this process's hit/miss/stored/corrupt tallies, and how many
+        # cached autotune verdicts came from the ledger.
+        out["store"].update(_xg.store_stats(load=True))
+        out["store"]["ledger_autotune"] = sum(
+            1 for d in decisions.values() if d.get("source") == "ledger"
+        )
+        from ..api import subset_cache_stats
+
+        out["inverse_cache"] = subset_cache_stats()
     except Exception as e:  # pragma: no cover - import-degraded env
         out["error"] = f"{type(e).__name__}: {e}"
     return out
@@ -452,7 +485,16 @@ def render(report: dict) -> str:
             f"auto -> {report['strategies']['auto']['strategy']} "
             f"({report['strategies']['auto']['source']}, mode "
             f"{report['strategies']['auto']['mode']}); xor schedules "
-            f"{len(report['strategies']['xor']['schedules'])} cached"
+            f"{len(report['strategies']['xor']['schedules'])} cached, "
+            "store "
+            + (
+                f"{report['strategies']['store']['entries'] or 0} "
+                f"entries "
+                f"({report['strategies']['store']['hits']} hits/"
+                f"{report['strategies']['store']['misses']} misses)"
+                if report["strategies"]["store"]["enabled"]
+                else "disabled"
+            )
             + (
                 ", " + ", ".join(
                     f"{s['digest']}:{s['terms_naive']}->{s['xors']} xors"

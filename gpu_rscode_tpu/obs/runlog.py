@@ -69,6 +69,37 @@ def enabled() -> bool:
     return path() is not None
 
 
+def store_path() -> str | None:
+    """The persistent schedule/autotune store (docs/XOR.md "The
+    persistent store"): by default it RIDES the run ledger — one file,
+    one rotation policy, one vocabulary (``kind: "rs_xor_schedule"`` /
+    ``"rs_autotune"`` records next to ``rs_run``/``rs_roofline``).
+    ``RS_SCHEDULE_STORE`` overrides: ``0``/``off`` disables persistence
+    even with a ledger configured, a path points the store at its own
+    file (a daemon sharing RS_RUNLOG across hosts but wanting a local
+    store), ``1``/``on`` is the explicit default."""
+    v = os.environ.get("RS_SCHEDULE_STORE")
+    if v is None or not v.strip():
+        return path()
+    s = v.strip()
+    if s.lower() in ("0", "off", "false", "no"):
+        return None
+    if s.lower() in ("1", "on", "true", "yes"):
+        return path()
+    return s
+
+
+def intra_op_threads() -> int:
+    """The effective intra-op thread count XLA CPU can use: the CPU
+    affinity mask when the platform exposes one (taskset/cgroup-aware),
+    else the host CPU count.  Recorded in every capture header so
+    multi-core scaling claims are tied to the cores that produced them."""
+    try:
+        return len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
 def git_sha() -> str | None:
     """Short git sha of the source tree, resolved once per process.
 
@@ -135,6 +166,13 @@ def capture_header(tool: str) -> dict:
         "git_sha": git_sha(),
         "host": socket.gethostname(),
         "backend": backend_name(),
+        # Parallelism identity (the multi-core scaling series needs the
+        # cores a row was measured on, not folklore about the bench box):
+        # physical host CPUs, the affinity-limited intra-op thread count,
+        # and any XLA_FLAGS steering the compiler.
+        "host_cpus": os.cpu_count() or 1,
+        "intra_op_threads": intra_op_threads(),
+        "xla_flags": os.environ.get("XLA_FLAGS") or None,
     }
 
 
@@ -150,6 +188,17 @@ def metrics_digest() -> str | None:
     return hashlib.sha256(snap.encode()).hexdigest()[:12]
 
 
+# Calibration/cache records carried forward across rotation: unlike
+# rs_run measurements (history — one rotated generation of which is
+# enough), these ARE the persistent state their subsystems reload on
+# process start (roofline: obs/attrib.py; schedule/autotune store:
+# docs/XOR.md).  Letting high-volume rs_run traffic rotate them away
+# would silently re-introduce the cold-start cost the store exists to
+# remove.  Carried records are capped at half the rotation budget so a
+# store bigger than the ledger cap cannot re-trigger rotation forever.
+_PRESERVED_KINDS = ("rs_roofline", "rs_xor_schedule", "rs_autotune")
+
+
 def _rotate(p: str, max_bytes: int) -> None:
     try:
         if os.path.getsize(p) < max_bytes:
@@ -160,6 +209,58 @@ def _rotate(p: str, max_bytes: int) -> None:
         os.replace(p, p + ".1")
     except OSError as e:
         warnings.warn(f"runlog rotation of {p!r} failed: {e}", stacklevel=3)
+        return
+    try:
+        # One record per logical identity, LATEST wins — the same
+        # resolution the loaders use — so a superseding record (a
+        # re-measured verdict, a re-stored schedule) can never lose its
+        # carry slot to its own stale predecessor.  When the deduped set
+        # still exceeds the budget, NEWEST records are kept first.
+        latest: dict[tuple, str] = {}
+        with open(p + ".1") as fp:
+            for line in fp:
+                stripped = line.strip()
+                if not stripped:
+                    continue
+                try:
+                    rec = json.loads(stripped)
+                except ValueError:
+                    continue
+                if not isinstance(rec, dict):
+                    continue
+                kind = rec.get("kind")
+                if kind not in _PRESERVED_KINDS:
+                    continue
+                if kind == "rs_autotune":
+                    ident = (kind, rec.get("host"), rec.get("backend"),
+                             rec.get("k"), rec.get("p"), rec.get("w"))
+                elif kind == "rs_xor_schedule":
+                    ident = (kind, rec.get("digest"), rec.get("cse"))
+                else:  # rs_roofline
+                    ident = (kind, rec.get("host"))
+                latest.pop(ident, None)  # re-insert: dict order = recency
+                latest[ident] = stripped
+        carried: list[str] = []
+        budget = max_bytes // 2
+        used = 0
+        for line in reversed(list(latest.values())):  # newest first
+            if used + len(line) + 1 > budget:
+                continue
+            carried.append(line)
+            used += len(line) + 1
+        if carried:
+            carried.reverse()  # restore oldest-to-newest file order
+            fd = os.open(p, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
+            try:
+                os.write(fd, ("\n".join(carried) + "\n").encode())
+            finally:
+                os.close(fd)
+    except OSError as e:
+        # The store degrades to a cold start — never fail the append.
+        warnings.warn(
+            f"runlog rotation could not carry calibration records: {e}",
+            stacklevel=3,
+        )
 
 
 def append(record: dict, ledger_path: str | None = None) -> None:
@@ -375,10 +476,12 @@ def filter_records(
     header once, not every row — so ``rs history --op io_bench`` trends a
     raw capture file); config filters compare against the record's
     ``config`` dict and skip records that lack the field only when the
-    filter asks for it.  Capture headers and roofline-calibration
-    records (``rs_roofline``, obs/attrib.py) are dropped — they are
-    identity/calibration state, not measurements, and must not occupy
-    trend-window slots or print as junk rows.
+    filter asks for it.  Capture headers, roofline-calibration records
+    (``rs_roofline``, obs/attrib.py) and persistent-store records
+    (``rs_xor_schedule``/``rs_autotune``, ops/xor_gemm.py + tune.py) are
+    dropped — they are identity/calibration/cache state, not
+    measurements, and must not occupy trend-window slots or print as
+    junk rows.
     """
     out = []
     header_tool = None
@@ -386,7 +489,8 @@ def filter_records(
         if r.get("kind") == "capture_header":
             header_tool = r.get("tool")
             continue
-        if r.get("kind") == "rs_roofline":
+        if r.get("kind") in ("rs_roofline", "rs_xor_schedule",
+                             "rs_autotune"):
             continue
         cfg = r.get("config") or {}
         if op is not None and op not in (

@@ -37,6 +37,30 @@ program, the compiler rematerializes pack subexpressions into every
 chain consumer and the whole thing runs ~2x slower than the sum of its
 parts (measured on XLA CPU; see docs/XOR.md for the numbers).
 
+Warm-path amortization (this file's other half):
+
+* **Persistent schedule store** — built schedules serialize into the
+  run-ledger-backed store (``obs.runlog.store_path()``: rides
+  ``RS_RUNLOG`` unless ``RS_SCHEDULE_STORE`` names its own path or
+  disables it), so a fresh CLI process or a restarted ``rs serve``
+  daemon loads the Paar-CSE result by matrix digest instead of
+  re-running the elimination.  Loads are validated (algorithm version,
+  shape fields, node-index bounds, payload checksum); anything torn or
+  foreign falls back to a recompute — never a crash, never a wrong
+  schedule (``rs_schedule_store_total{outcome}``).
+* **Packed-operand reuse** — :class:`PackedOperand` carries a staged
+  segment's bit-planes between chained dispatches that consume the same
+  ``B`` (locate decode's syndrome + recovery GEMMs), so the second
+  consumer skips the pack stage entirely.  Pack wall is its own metric
+  (``rs_xor_pack_seconds``, recorded only under ``RS_XOR_PACK_TIMING=1``
+  + metrics — the timing must block on the planes, so it is opt-in on
+  top of RS_METRICS and the production path never loses its async
+  pack->chain overlap).
+* **Shared stage executables** — pack/unpack depend only on the operand
+  class (rows, cols, dtype, w), not the schedule, so they compile once
+  per class and are shared across every pipeline (decode survivor-set
+  churn no longer recompiles the transpose machinery per subset).
+
 Env knobs (read at schedule build / pipeline compile time):
 
 * ``RS_XOR_CSE=0`` — disable Paar CSE (naive per-row term lists; larger
@@ -45,13 +69,19 @@ Env knobs (read at schedule build / pipeline compile time):
   count exceeds this (default 32768): compile time scales with the term
   count, and a pathological (k, rows, w) combination should fail with an
   actionable error instead of hanging the build.
+* ``RS_SCHEDULE_STORE`` — ``0``/``off`` disables schedule persistence,
+  a path overrides the default (the ``RS_RUNLOG`` ledger).
+* ``RS_XOR_PACK_REUSE=0`` — disable packed-operand reuse (callers fall
+  back to per-dispatch packing; A/B escape hatch).
 """
 
 from __future__ import annotations
 
 import functools
 import hashlib
+import json
 import os
+import socket
 import threading
 import time
 from dataclasses import dataclass
@@ -59,11 +89,14 @@ from dataclasses import dataclass
 import numpy as np
 
 from .gf import get_field
+from ..obs import metrics as _metrics
 
 __all__ = [
-    "XorSchedule", "XorPipeline", "build_schedule", "matrix_digest",
-    "gf_matmul_xor", "get_pipeline", "clear_pipeline_cache",
-    "schedule_stats", "pipeline_stats",
+    "XorSchedule", "XorPipeline", "PackedOperand", "build_schedule",
+    "matrix_digest", "gf_matmul_xor", "get_pipeline",
+    "clear_pipeline_cache", "schedule_stats", "pipeline_stats",
+    "pack_operand", "pack_reuse_enabled", "pack_timing_enabled",
+    "store_stats",
 ]
 
 _SUPPORTED_W = (8, 16)
@@ -83,6 +116,14 @@ def _max_terms() -> int:
 
 def _cse_enabled() -> bool:
     return os.environ.get("RS_XOR_CSE", "1").lower() not in (
+        "0", "false", "off", "no"
+    )
+
+
+def pack_reuse_enabled() -> bool:
+    """Whether chained consumers may share a :class:`PackedOperand`
+    (RS_XOR_PACK_REUSE, default on; read per call so tests/A-B toggle)."""
+    return os.environ.get("RS_XOR_PACK_REUSE", "1").lower() not in (
         "0", "false", "off", "no"
     )
 
@@ -212,8 +253,219 @@ _SCHEDULE_CACHE: dict[tuple, XorSchedule] = {}
 _SCHEDULE_LOCK = threading.Lock()
 
 
+# -- persistent schedule store (docs/XOR.md "The persistent store") ----------
+#
+# Schedules are pure data — a deterministic function of (matrix digest,
+# cse flag, algorithm version) — so persisting them is safe across
+# processes and PLAN_CACHE.clear(): unlike the pipeline/plan caches
+# (which pin executables XLA may have evicted), a reloaded schedule is
+# byte-identical to a rebuilt one.  Every load re-validates shape fields,
+# node-index bounds and the payload checksum, so a torn ledger line or a
+# foreign record recomputes instead of crashing or mis-scheduling.
+
+_STORE_ALGO = 1  # bump when the lowering/CSE output format changes
+
+_STORE_LOCK = threading.Lock()
+_STORE_INDEX: dict[tuple, dict] | None = None  # (digest, cse) -> record
+# ``built`` counts real Paar-CSE computations this process ran (store on
+# or off) — the CI warm-start validator asserts a second process against
+# a warm store builds ZERO.
+_STORE_STATS = {"hits": 0, "misses": 0, "stored": 0, "corrupt": 0,
+                "built": 0}
+
+
+def _store_path() -> str | None:
+    from ..obs import runlog as _runlog
+
+    return _runlog.store_path()
+
+
+def _count_store(outcome: str) -> None:
+    _metrics.counter(
+        "rs_schedule_store_total",
+        "persistent XOR-schedule store lookups by outcome",
+    ).labels(outcome=outcome).inc()
+
+
+def _rec_ts(rec: dict) -> float:
+    try:
+        return float(rec.get("ts") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _store_index() -> dict[tuple, dict]:
+    """Lazy-loaded (digest, cse) -> record index of the store file.
+    The NEWEST timestamp wins, not file order: rotation carries old
+    records forward and may interleave them after concurrent fresh
+    appends, so position in the file proves nothing about recency."""
+    global _STORE_INDEX
+    with _STORE_LOCK:
+        if _STORE_INDEX is not None:
+            return _STORE_INDEX
+    p = _store_path()
+    idx: dict[tuple, dict] = {}
+    if p:
+        from ..obs import runlog as _runlog
+
+        for rec in _runlog.read_records(p):
+            if rec.get("kind") != "rs_xor_schedule":
+                continue
+            digest = rec.get("digest")
+            if not isinstance(digest, str):
+                continue
+            key = (digest, bool(rec.get("cse")))
+            cur = idx.get(key)
+            if cur is None or _rec_ts(rec) >= _rec_ts(cur):
+                idx[key] = rec
+    with _STORE_LOCK:
+        if _STORE_INDEX is None:
+            _STORE_INDEX = idx
+        return _STORE_INDEX
+
+
+def _reset_store_index() -> None:
+    """Forget the loaded index (next lookup re-reads the store file) —
+    paired with cache clears so a clear can never serve an index that
+    predates concurrent writers, and tests can re-point the store env."""
+    global _STORE_INDEX
+    with _STORE_LOCK:
+        _STORE_INDEX = None
+
+
+def _payload_digest(pair_ops, rows) -> str:
+    h = hashlib.blake2b(digest_size=8)
+    payload = [
+        [[int(a), int(b)] for a, b in pair_ops],
+        [[int(t) for t in r] for r in rows],
+    ]
+    h.update(json.dumps(payload, separators=(",", ":")).encode())
+    return h.hexdigest()
+
+
+def _schedule_from_store(digest: str, cse: bool, A: np.ndarray,
+                         w: int) -> XorSchedule | None:
+    """Validated store load for one (digest, cse); None on miss or on any
+    corruption (counted ``corrupt`` — the caller recomputes)."""
+    if not _store_path():
+        return None
+    rec = _store_index().get((digest, cse))
+    if rec is None:
+        with _STORE_LOCK:
+            _STORE_STATS["misses"] += 1
+        _count_store("miss")
+        return None
+    try:
+        if rec.get("algo") != _STORE_ALGO:
+            raise ValueError("algorithm version mismatch")
+        rows_out, k = int(rec["rows_out"]), int(rec["k"])
+        n_inputs = int(rec["n_inputs"])
+        if (int(rec["w"]), rows_out, k) != (w, A.shape[0], A.shape[1]):
+            raise ValueError("shape fields disagree with the matrix")
+        if n_inputs != k * w:
+            raise ValueError("n_inputs inconsistent with (k, w)")
+        pair_ops = tuple(
+            (int(a), int(b)) for a, b in rec["pair_ops"]
+        )
+        rows = tuple(tuple(int(t) for t in r) for r in rec["rows"])
+        if len(rows) != rows_out * w:
+            raise ValueError("row count inconsistent with (rows_out, w)")
+        for t, (a, b) in enumerate(pair_ops):
+            if not (0 <= a < n_inputs + t and 0 <= b < n_inputs + t):
+                raise ValueError("pair op references an undefined node")
+        n_nodes = n_inputs + len(pair_ops)
+        for r in rows:
+            for t in r:
+                if not 0 <= t < n_nodes:
+                    raise ValueError("row term references an undefined node")
+        if rec.get("payload_digest") != _payload_digest(pair_ops, rows):
+            raise ValueError("payload checksum mismatch")
+        sched = XorSchedule(
+            digest=digest, w=w, rows_out=rows_out, k=k, n_inputs=n_inputs,
+            pair_ops=pair_ops, rows=rows,
+            terms_naive=int(rec["terms_naive"]),
+            terms_cse=int(rec["terms_cse"]),
+            cse=cse, build_seconds=0.0,
+        )
+    except Exception:
+        # Torn line, foreign writer, stale algorithm — recompute (and
+        # re-store, superseding the bad record).  Never crash, never
+        # trust unvalidated XOR terms.
+        with _STORE_LOCK:
+            if _STORE_INDEX is not None:
+                # Forget the bad record so the recompute's store append
+                # is not skipped as "already present".
+                _STORE_INDEX.pop((digest, cse), None)
+            _STORE_STATS["corrupt"] += 1
+        _count_store("corrupt")
+        return None
+    with _STORE_LOCK:
+        _STORE_STATS["hits"] += 1
+    _count_store("hit")
+    return sched
+
+
+def _schedule_to_store(sched: XorSchedule) -> None:
+    """Best-effort append of a freshly built schedule (no-op when the
+    store is disabled or the record is already present)."""
+    p = _store_path()
+    if not p:
+        return
+    key = (sched.digest, sched.cse)
+    idx = _store_index()
+    if key in idx:
+        return
+    from ..obs import runlog as _runlog
+
+    rec = {
+        "kind": "rs_xor_schedule",
+        "schema": _runlog.SCHEMA_VERSION,
+        "algo": _STORE_ALGO,
+        "digest": sched.digest,
+        "cse": sched.cse,
+        "w": sched.w,
+        "rows_out": sched.rows_out,
+        "k": sched.k,
+        "n_inputs": sched.n_inputs,
+        "pair_ops": [list(p_) for p_ in sched.pair_ops],
+        "rows": [list(r) for r in sched.rows],
+        "payload_digest": _payload_digest(sched.pair_ops, sched.rows),
+        "terms_naive": sched.terms_naive,
+        "terms_cse": sched.terms_cse,
+        "build_seconds": round(sched.build_seconds, 6),
+        "ts": time.time(),
+        "run": _runlog.run_id(),
+        "host": socket.gethostname(),
+    }
+    _runlog.append(rec, p)
+    with _STORE_LOCK:
+        if _STORE_INDEX is not None:
+            _STORE_INDEX[key] = rec
+        _STORE_STATS["stored"] += 1
+    _count_store("stored")
+
+
+def store_stats(load: bool = False) -> dict:
+    """Persistent-store facts for `rs doctor` / daemon stats: resolved
+    path, entry count (``load=True`` forces the index read; otherwise
+    only a previously loaded index is counted) and this process's
+    hit/miss/stored/corrupt tallies."""
+    p = _store_path()
+    if load and p:
+        _store_index()
+    with _STORE_LOCK:
+        entries = (
+            len(_STORE_INDEX) if _STORE_INDEX is not None else None
+        )
+        out = dict(_STORE_STATS)
+    out.update({"path": p, "enabled": p is not None, "entries": entries})
+    return out
+
+
 def build_schedule(A, w: int, cse: bool | None = None) -> XorSchedule:
-    """Lower ``A`` to GF(2) and CSE-schedule it, cached by digest."""
+    """Lower ``A`` to GF(2) and CSE-schedule it — cached by digest
+    in-process, then by the persistent store, then computed (and stored
+    so the next process skips the Paar pass)."""
     if w not in _SUPPORTED_W:
         raise ValueError(
             f"strategy='xor' supports w in {_SUPPORTED_W}, got w={w}"
@@ -227,6 +479,12 @@ def build_schedule(A, w: int, cse: bool | None = None) -> XorSchedule:
         hit = _SCHEDULE_CACHE.get(key)
     if hit is not None:
         return hit
+    loaded = _schedule_from_store(digest, bool(cse), A, w)
+    if loaded is not None:
+        with _SCHEDULE_LOCK:
+            return _SCHEDULE_CACHE.setdefault(key, loaded)
+    with _STORE_LOCK:
+        _STORE_STATS["built"] += 1
     t0 = time.perf_counter()
     abin = binary_matrix(A, w)
     naive = int(abin.sum())
@@ -250,12 +508,13 @@ def build_schedule(A, w: int, cse: bool | None = None) -> XorSchedule:
         k=A.shape[1],
         n_inputs=abin.shape[1],
         pair_ops=tuple(pair_ops),
-        rows=tuple(tuple(sorted(s)) for s in row_sets),
+        rows=tuple(tuple(int(t) for t in sorted(s)) for s in row_sets),
         terms_naive=naive,
         terms_cse=len(pair_ops) + sum(len(s) for s in row_sets),
         cse=bool(cse),
         build_seconds=time.perf_counter() - t0,
     )
+    _schedule_to_store(sched)
     with _SCHEDULE_LOCK:
         return _SCHEDULE_CACHE.setdefault(key, sched)
 
@@ -438,11 +697,10 @@ def _chain_stage(nodes, schedule: XorSchedule):
     )
 
 
-def _unpack_stage(outs, schedule: XorSchedule, cols: int):
+def _unpack_stage(outs, w: int, rows_out: int, cols: int):
     import jax.numpy as jnp
     from jax import lax
 
-    w, rows_out = schedule.w, schedule.rows_out
     pieces = []
     for ri in range(rows_out):
         pieces.extend(_unpack_row_pieces(outs[ri * w:(ri + 1) * w], w))
@@ -453,6 +711,164 @@ def _unpack_stage(outs, schedule: XorSchedule, cols: int):
         )
     return lax.bitcast_convert_type(words, jnp.uint16).reshape(
         rows_out, cols
+    )
+
+
+# -- shared stage executables -------------------------------------------------
+#
+# pack/unpack are pure layout transforms: they depend on the operand
+# class (rows, cols, dtype, w) but NOT on the schedule, so they compile
+# once per class and every pipeline of that class shares them — decode
+# survivor-set churn compiles one chain per subset, not three stages.
+
+_STAGE_CACHE: dict[tuple, object] = {}
+_STAGE_LOCK = threading.Lock()
+
+
+def _plane_struct(cols: int):
+    import jax
+
+    return jax.ShapeDtypeStruct((cols // _COL_ALIGN,), np.uint32)
+
+
+def _pack_exe(rows: int, cols: int, dtype, w: int):
+    """Compiled pack stage for one (rows, cols, dtype, w) operand class."""
+    import jax
+
+    key = ("pack", rows, cols, np.dtype(dtype).str, w)
+    with _STAGE_LOCK:
+        hit = _STAGE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    exe = (
+        jax.jit(lambda b: _pack_stage(b, w))
+        .lower(jax.ShapeDtypeStruct((rows, cols), np.dtype(dtype)))
+        .compile()
+    )
+    with _STAGE_LOCK:
+        return _STAGE_CACHE.setdefault(key, exe)
+
+
+def _unpack_exe(rows_out: int, cols: int, w: int):
+    """Compiled unpack stage for one (rows_out, cols, w) output class."""
+    import jax
+
+    key = ("unpack", rows_out, cols, w)
+    with _STAGE_LOCK:
+        hit = _STAGE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    outs_struct = tuple([_plane_struct(cols)] * (rows_out * w))
+    exe = (
+        jax.jit(lambda os_: _unpack_stage(os_, w, rows_out, cols))
+        .lower(outs_struct)
+        .compile()
+    )
+    with _STAGE_LOCK:
+        return _STAGE_CACHE.setdefault(key, exe)
+
+
+def pack_timing_enabled() -> bool:
+    """Whether pack-stage walls are recorded (``RS_XOR_PACK_TIMING=1``
+    AND metrics on).  Opt-in on top of RS_METRICS because the timing
+    must BLOCK on the planes: a production deployment scraping metrics
+    would otherwise lose the async pack->chain overlap on EVERY xor
+    dispatch, not just the ones being measured."""
+    return _metrics.enabled() and os.environ.get(
+        "RS_XOR_PACK_TIMING", "0"
+    ).lower() in ("1", "true", "on", "yes")
+
+
+def _observed_pack(exe, B):
+    """Run a pack executable, timing its wall into ``rs_xor_pack_seconds``
+    when pack timing is opted in (see :func:`pack_timing_enabled`).  The
+    default path — timing off — is the plain async dispatch and costs
+    nothing."""
+    if not pack_timing_enabled():
+        return exe(B)
+    import jax
+
+    t0 = time.perf_counter()
+    planes = exe(B)
+    jax.block_until_ready(planes)
+    _metrics.quantile(
+        "rs_xor_pack_seconds",
+        "xor pack-stage wall seconds (streaming quantiles)",
+    ).observe(time.perf_counter() - t0)
+    return planes
+
+
+def _count_pack_reuse(outcome: str) -> None:
+    _metrics.counter(
+        "rs_xor_pack_reuse_total",
+        "xor pack-stage executions vs packed-operand reuses",
+    ).labels(outcome=outcome).inc()
+
+
+class PackedOperand:
+    """A ``B`` operand already in the packed bit-plane domain.
+
+    The warm-path handle (docs/XOR.md "Packed-operand reuse"): chained
+    xor dispatches that consume the same staged segment — locate
+    decode's syndrome GEMM then its recovery GEMM — pack it ONCE and
+    thread this handle through ``codec``/``plan``; the second consumer
+    skips ``_pack_stage`` entirely.  ``planes`` is the row-major tuple
+    of ``rows * w`` plane vectors; :meth:`select` restricts to a row
+    subset (pure tuple slicing — planes are per-row, so a row subset is
+    a plane subset).  ``cols_true``/``cap`` carry the plan-layer
+    bookkeeping of the staged segment the planes came from.
+    """
+
+    __slots__ = ("planes", "rows", "cols", "w", "dtype", "cols_true",
+                 "cap")
+
+    def __init__(self, planes, rows: int, cols: int, w: int, dtype,
+                 cols_true: int | None = None, cap: int | None = None):
+        self.planes = tuple(planes)
+        self.rows = int(rows)
+        self.cols = int(cols)
+        self.w = int(w)
+        self.dtype = np.dtype(dtype)
+        self.cols_true = int(cols_true) if cols_true is not None else cols
+        self.cap = cap
+
+    @property
+    def shape(self):
+        return (self.rows, self.cols)
+
+    def select(self, row_positions) -> "PackedOperand":
+        """Packed view of a row subset, in the given order."""
+        w = self.w
+        planes: list = []
+        for r in row_positions:
+            r = int(r)
+            if not 0 <= r < self.rows:
+                raise ValueError(
+                    f"row {r} out of range for packed operand of "
+                    f"{self.rows} rows"
+                )
+            planes.extend(self.planes[r * w:(r + 1) * w])
+        return PackedOperand(
+            planes, len(planes) // w, self.cols, w, self.dtype,
+            cols_true=self.cols_true, cap=self.cap,
+        )
+
+
+def pack_operand(B, w: int, *, cols_true: int | None = None,
+                 cap: int | None = None) -> PackedOperand:
+    """Pack a concrete (rows, cols) symbol array once for reuse across
+    chained dispatches.  ``cols`` must already be 32-aligned (the plan
+    layer's staged segments are; use :func:`padded_cols` otherwise)."""
+    rows, cols = B.shape
+    if cols % _COL_ALIGN:
+        raise ValueError(
+            f"packed operand cols must be {_COL_ALIGN}-aligned, got {cols}"
+        )
+    exe = _pack_exe(rows, cols, B.dtype, w)
+    planes = _observed_pack(exe, B)
+    _count_pack_reuse("packed")
+    return PackedOperand(
+        planes, rows, cols, w, B.dtype, cols_true=cols_true, cap=cap
     )
 
 
@@ -487,26 +903,19 @@ class XorPipeline:
         self.calls = 0
         t0 = time.perf_counter()
         w = schedule.w
-        b_struct = jax.ShapeDtypeStruct((k, cols), self.dtype)
-        self._pack = (
-            jax.jit(lambda b: _pack_stage(b, w))
-            .lower(b_struct).compile()
-        )
-        # One plane vector holds one bit of every symbol column: cols/32
-        # packed uint32 words for BOTH widths (w=16 splits into lo/hi
-        # byte streams first, doubling the plane count, not their size).
-        nw = cols // _COL_ALIGN
-        plane = jax.ShapeDtypeStruct((nw,), np.uint32)
-        nodes_struct = tuple([plane] * (k * w))
+        # pack/unpack come from the shared per-class stage cache (they
+        # are schedule-independent); only the chain is compiled per
+        # schedule.  One plane vector holds one bit of every symbol
+        # column: cols/32 packed uint32 words for BOTH widths (w=16
+        # splits into lo/hi byte streams first, doubling the plane
+        # count, not their size).
+        self._pack = _pack_exe(k, cols, self.dtype, w)
+        nodes_struct = tuple([_plane_struct(cols)] * (k * w))
         self._chain = (
             jax.jit(lambda ns: _chain_stage(ns, schedule))
             .lower(nodes_struct).compile()
         )
-        outs_struct = tuple([plane] * (schedule.rows_out * w))
-        self._unpack = (
-            jax.jit(lambda os: _unpack_stage(os, schedule, cols))
-            .lower(outs_struct).compile()
-        )
+        self._unpack = _unpack_exe(schedule.rows_out, cols, w)
         self.compile_seconds = time.perf_counter() - t0
         self.cost_analysis = self._merged_cost()
 
@@ -524,7 +933,29 @@ class XorPipeline:
 
     def __call__(self, A, B):
         self.calls += 1
-        return self._unpack(self._chain(self._pack(B)))
+        if isinstance(B, PackedOperand):
+            # Warm path: the operand was packed once by an earlier
+            # consumer (docs/XOR.md) — validate the class and skip the
+            # pack stage entirely.
+            if (B.rows, B.cols, B.w) != (
+                self.k, self.cols, self.schedule.w
+            ) or B.dtype != self.dtype:
+                raise ValueError(
+                    f"packed operand ({B.rows}x{B.cols}, w={B.w}, "
+                    f"{B.dtype}) does not match pipeline "
+                    f"({self.k}x{self.cols}, w={self.schedule.w}, "
+                    f"{self.dtype})"
+                )
+            _count_pack_reuse("reused")
+            planes = B.planes
+        else:
+            # Pipeline-internal packs count too: the packed-vs-reused
+            # comparison is only meaningful if EVERY pack execution
+            # lands in the "packed" bucket, including the fallback
+            # re-packs after a located correction drops its handle.
+            _count_pack_reuse("packed")
+            planes = _observed_pack(self._pack, B)
+        return self._unpack(self._chain(planes))
 
     def describe(self) -> dict:
         s = self.schedule
@@ -564,12 +995,21 @@ def get_pipeline(A, B_shape, B_dtype, w: int) -> XorPipeline:
 
 
 def clear_pipeline_cache() -> None:
-    """Drop compiled pipelines AND schedules (paired with plan-cache
-    clears: both pin executables XLA may since have evicted)."""
+    """Drop compiled pipelines, shared stage executables AND schedules
+    (paired with plan-cache clears: the executables pin compiles XLA may
+    since have evicted).  The persistent store's in-memory INDEX is also
+    reset — but not the store file: schedules are pure data (deterministic
+    in (digest, cse, algo version)), so a post-clear load re-reads and
+    re-validates from disk; it cannot resurrect anything stale, and a
+    corrupt entry falls back to recompute (tests/test_warm_path.py pins
+    both halves of that contract)."""
     with _PIPELINE_LOCK:
         _PIPELINE_CACHE.clear()
+    with _STAGE_LOCK:
+        _STAGE_CACHE.clear()
     with _SCHEDULE_LOCK:
         _SCHEDULE_CACHE.clear()
+    _reset_store_index()
 
 
 def pipeline_stats() -> list[dict]:
@@ -618,7 +1058,8 @@ def gf_matmul_xor(A, B, w: int = 8):
         # concrete — only the data is traced).
         schedule = build_schedule(A, w)
         out = _unpack_stage(
-            _chain_stage(_pack_stage(B, w), schedule), schedule, cols
+            _chain_stage(_pack_stage(B, w), schedule),
+            schedule.w, schedule.rows_out, cols,
         )
     else:
         pipe = get_pipeline(A, (k, cols), dtype, w)

@@ -450,6 +450,15 @@ class PlanCache:
 
         clear_autotune_cache()
         clear_pipeline_cache()
+        # The generation-keyed survivor-subset cache (api.py) memoizes
+        # inverses whose xor schedules live in the caches just dropped —
+        # clear it too so a post-clear decode re-derives rather than
+        # assuming a warm schedule that no longer exists.  (Persistent
+        # STORE entries survive by design: they are pure data, re-read
+        # and re-validated on the next build — see clear_pipeline_cache.)
+        from .api import clear_subset_cache
+
+        clear_subset_cache()
 
     def stats(self) -> dict:
         # Snapshot under the cache lock, describe() OUTSIDE it: describe
@@ -531,11 +540,19 @@ def dispatch(
         # per matrix, shared by every dispatch — docs/XOR.md); the
         # bucket additionally rounds up to the pipeline's 32-symbol
         # pack alignment (ragged caps only — ladder buckets are already
-        # 128-aligned).
-        from .ops.xor_gemm import matrix_digest, padded_cols
+        # 128-aligned).  ``B`` may be a PackedOperand — a bit-plane
+        # handle an earlier chained dispatch packed (docs/XOR.md
+        # "Packed-operand reuse"); the pipeline skips its pack stage.
+        from .ops.xor_gemm import PackedOperand, matrix_digest, padded_cols
 
         bucket = max(bucket, padded_cols(bucket))
         key = key[:4] + (bucket,) + key[5:] + (matrix_digest(A, w),)
+        if isinstance(B, PackedOperand) and B.shape[1] != bucket:
+            raise ValueError(
+                f"packed operand cols {B.shape[1]} does not match the "
+                f"plan bucket {bucket} — pack after staging, with the "
+                "same cap"
+            )
     plan = PLAN_CACHE.lookup(key, strategy, w, bucket)
     B = _pad_to(B, bucket)
     if eager_fn is not None:

@@ -578,6 +578,14 @@ class ServeDaemon:
         }
 
     def stats(self) -> dict:
+        # Warm-path facts next to the queue counters: which strategy
+        # decisions this daemon is running on (measured / ledger-loaded)
+        # and whether schedule compiles are being served by the
+        # persistent store — the restart-latency story in one scrape
+        # (docs/XOR.md "The persistent store").
+        from .. import tune as _tune
+        from ..ops import xor_gemm as _xg
+
         return {
             "queue": self.queue.snapshot(),
             "batcher": self.batcher.snapshot(),
@@ -585,6 +593,10 @@ class ServeDaemon:
             "inflight": self._inflight,
             "requests_done": self.requests_done,
             "requests_failed": self.requests_failed,
+            "strategies": {
+                "autotune_decisions": _tune.decisions(),
+                "schedule_store": _xg.store_stats(),
+            },
         }
 
     # -- scheduling / execution ----------------------------------------------

@@ -17,6 +17,21 @@ against the NumPy GF oracle on a leading slab before any timing counts.
 The capture row records per-strategy GB/s plus the xor/table speedup;
 ``bench_captures/xor_ab_*.jsonl`` joins the BENCH trajectory via the
 shared ``capture_header``.
+
+``--locate-ab`` measures the OTHER xor warm-path tax: the locate-decode
+chain (syndrome GEMM then recovery GEMM over the same survivor stack)
+with packed-domain reuse on vs off (``RS_XOR_PACK_REUSE``, docs/XOR.md
+"Packed-operand reuse").  Arms are interleaved per trial on one archive
+with one native chunk missing; pack wall comes from the
+``rs_xor_pack_seconds`` series (metrics force-enabled for the tool's
+lifetime), and every decode's output is verified byte-identical to the
+original file before its timing counts.
+
+``--intra-op-threads N`` pins the process CPU affinity to N cores
+before the backend initialises (the supported intra-op parallelism
+control for XLA CPU); the resulting core counts land in the capture
+header (``host_cpus`` / ``intra_op_threads``) so the ROADMAP's
+multi-core scaling claim can be measured as a series, box by box.
 """
 
 from __future__ import annotations
@@ -132,6 +147,162 @@ def run_ab(
     return [row]
 
 
+def run_locate_ab(
+    *,
+    size_mb: float,
+    k: int,
+    p: int,
+    w: int,
+    trials: int,
+    quiet: bool = False,
+) -> list[dict]:
+    """Paired locate-decode A/B: packed-domain reuse on vs off.
+
+    One archive, one missing native chunk (so the recovery GEMM runs),
+    ``strategy="xor"`` throughout, TWO interleaved passes:
+
+    * **wall pass** (metrics disabled): end-to-end locate wall per arm,
+      best-of-trials — the ``rs_xor_pack_seconds`` timing blocks on the
+      pack planes, so walls are measured with it off to keep the async
+      pipeline the production one.
+    * **pack pass** (metrics force-enabled): per-run
+      ``rs_xor_pack_seconds`` sum delta per arm, best-of-trials — the
+      reuse arm packs the survivor stack once per segment where the
+      classic path packs it for the syndrome GEMM and re-packs the
+      survivor subset for the recovery GEMM.
+
+    Outputs are byte-verified against the original before any timing
+    counts.
+    """
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from .. import api
+    from ..obs import metrics as _metrics
+
+    size = int(size_mb * 1024 * 1024)
+    tmp = tempfile.mkdtemp(prefix="rs_locate_ab_")
+    was_forced = _metrics.forced()
+    env_before = os.environ.get("RS_XOR_PACK_REUSE")
+    try:
+        src = os.path.join(tmp, "payload.bin")
+        rng = np.random.default_rng(20260804)
+        with open(src, "wb") as fp:
+            fp.write(rng.integers(0, 256, size, dtype=np.uint8).tobytes())
+        api.encode_file(src, k, p, w=w, strategy="xor")
+        original = open(src, "rb").read()
+        os.unlink(api.chunk_file_name(src, k // 2))  # a native erasure
+        out = os.path.join(tmp, "out.bin")
+
+        def run_once(reuse: bool) -> float:
+            os.environ["RS_XOR_PACK_REUSE"] = "1" if reuse else "0"
+            t0 = time.perf_counter()
+            api.locate_decode_file(src, out, strategy="xor")
+            return time.perf_counter() - t0
+
+        def pack_sum() -> float:
+            snap = _metrics.REGISTRY.snapshot().get(
+                "rs_xor_pack_seconds", {}
+            )
+            vals = snap.get("values", {}).get("", {})
+            return float(vals.get("sum", 0.0))
+
+        # Byte verification first (either arm wrong = no numbers at all).
+        for reuse in (True, False):
+            run_once(reuse)
+            if open(out, "rb").read() != original:
+                raise AssertionError(
+                    f"locate decode (reuse={reuse}) output differs from "
+                    "the original"
+                )
+
+        walls = {"reuse": [], "noreuse": []}
+        packs = {"reuse": [], "noreuse": []}
+        # Walls need pack timing GENUINELY off — its block_until_ready
+        # changes the async pipeline the walls are supposed to measure.
+        # force_enable(False) alone cannot override an ambient
+        # RS_METRICS=1, so the env is popped for the wall pass.
+        metrics_env = os.environ.pop("RS_METRICS", None)
+        timing_env = os.environ.get("RS_XOR_PACK_TIMING")
+        _metrics.force_enable(False)
+        try:
+            for _ in range(max(1, trials)):
+                for arm, reuse in (("reuse", True), ("noreuse", False)):
+                    run_once(reuse)  # warm streak
+                    walls[arm].append(run_once(reuse))
+        finally:
+            if metrics_env is not None:
+                os.environ["RS_METRICS"] = metrics_env
+        _metrics.force_enable(True)
+        os.environ["RS_XOR_PACK_TIMING"] = "1"  # opt in for the pack pass
+        try:
+            for _ in range(max(1, trials)):
+                for arm, reuse in (("reuse", True), ("noreuse", False)):
+                    run_once(reuse)  # warm streak
+                    p0 = pack_sum()
+                    run_once(reuse)
+                    packs[arm].append(pack_sum() - p0)
+        finally:
+            if timing_env is None:
+                os.environ.pop("RS_XOR_PACK_TIMING", None)
+            else:
+                os.environ["RS_XOR_PACK_TIMING"] = timing_env
+    finally:
+        if env_before is None:
+            os.environ.pop("RS_XOR_PACK_REUSE", None)
+        else:
+            os.environ["RS_XOR_PACK_REUSE"] = env_before
+        _metrics.force_enable(was_forced)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    best = {arm: min(ws) for arm, ws in walls.items()}
+    pack_best = {arm: min(ps) for arm, ps in packs.items()}
+    reduction = (
+        round(1.0 - pack_best["reuse"] / pack_best["noreuse"], 4)
+        if pack_best["noreuse"] > 0 else None
+    )
+    row = {
+        "kind": "xor_locate_ab",
+        "op": "locate_decode",
+        "config": {"k": k, "n": k + p, "w": w},
+        "bytes": size,
+        "trials": trials,
+        "walls_s": {a: [round(x, 6) for x in ws]
+                    for a, ws in walls.items()},
+        "pack_s": {a: [round(x, 6) for x in ps]
+                   for a, ps in packs.items()},
+        "best_wall_s": {a: round(v, 6) for a, v in best.items()},
+        "best_pack_s": {a: round(v, 6) for a, v in pack_best.items()},
+        "pack_reduction": reduction,
+        "wall_speedup": round(best["noreuse"] / best["reuse"], 4),
+    }
+    if not quiet:
+        print(
+            f"xor_locate_ab: k={k} p={p} w={w} {size >> 20}MiB: pack "
+            f"{pack_best['noreuse']:.4f}s -> {pack_best['reuse']:.4f}s "
+            f"({(reduction or 0) * 100:.1f}% less), wall "
+            f"{best['noreuse']:.4f}s -> {best['reuse']:.4f}s",
+            file=sys.stderr,
+        )
+    return [row]
+
+
+def _apply_intra_op_threads(n: int) -> None:
+    """Pin CPU affinity to ``n`` cores BEFORE backend init — the
+    supported intra-op parallelism control for XLA CPU (its thread pool
+    sizes from schedulable CPUs)."""
+    if n <= 0:
+        return
+    try:
+        cur = sorted(os.sched_getaffinity(0))
+        os.sched_setaffinity(0, set(cur[:n]))
+    except (AttributeError, OSError) as e:
+        print(f"xor_ab: cannot pin affinity to {n} cores: {e}",
+              file=sys.stderr)
+
+
 def main(argv=None) -> int:
     import argparse
 
@@ -141,10 +312,19 @@ def main(argv=None) -> int:
         prog="xor_ab",
         description="A/B: the XOR-lowered bitsliced GF GEMM strategy vs "
         "table (and friends) on the bench workload stripe encode, "
-        "paired best-of-trials, oracle-verified (docs/XOR.md).",
+        "paired best-of-trials, oracle-verified (docs/XOR.md); "
+        "--locate-ab measures packed-domain reuse on the locate-decode "
+        "chain instead.",
     )
     ap.add_argument("--ab", action="store_true",
-                    help="run the A/B comparison (the only mode)")
+                    help="run the encode A/B comparison")
+    ap.add_argument("--locate-ab", action="store_true",
+                    help="run the locate-decode packed-reuse A/B "
+                    "(RS_XOR_PACK_REUSE on vs off)")
+    ap.add_argument("--intra-op-threads", type=int, default=0,
+                    help="pin CPU affinity to N cores before backend "
+                    "init (0 = leave as-is); recorded in the capture "
+                    "header")
     ap.add_argument("--size-mb", type=float, default=20.0,
                     help="stripe payload in MiB (default 20)")
     ap.add_argument("--k", type=int, default=10,
@@ -164,16 +344,26 @@ def main(argv=None) -> int:
         args = ap.parse_args(argv)
     except SystemExit as e:
         return int(e.code or 0)
-    if not args.ab:
-        print("xor_ab: pass --ab (the A/B comparison is the bench)",
-              file=sys.stderr)
+    if not (args.ab or args.locate_ab):
+        print("xor_ab: pass --ab or --locate-ab (the A/B comparison is "
+              "the bench)", file=sys.stderr)
         return 2
-    strategies = [s.strip() for s in args.strategies.split(",") if s]
+    if args.intra_op_threads:
+        _apply_intra_op_threads(args.intra_op_threads)
 
-    rows = run_ab(
-        size_mb=args.size_mb, k=args.k, p=args.p, w=args.w,
-        strategies=strategies, trials=args.trials, quiet=args.json,
-    )
+    if args.locate_ab:
+        tool = "xor_locate_ab"
+        rows = run_locate_ab(
+            size_mb=args.size_mb, k=args.k, p=args.p, w=args.w,
+            trials=args.trials, quiet=args.json,
+        )
+    else:
+        tool = "xor_ab"
+        strategies = [s.strip() for s in args.strategies.split(",") if s]
+        rows = run_ab(
+            size_mb=args.size_mb, k=args.k, p=args.p, w=args.w,
+            strategies=strategies, trials=args.trials, quiet=args.json,
+        )
 
     capture = args.capture
     if capture is None:
@@ -181,11 +371,11 @@ def main(argv=None) -> int:
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
         capture = os.path.join(
             "bench_captures",
-            f"xor_ab_{_runlog.backend_name() or 'cpu'}_{stamp}.jsonl",
+            f"{tool}_{_runlog.backend_name() or 'cpu'}_{stamp}.jsonl",
         )
     if capture != "-":
         with open(capture, "w") as fp:
-            fp.write(json.dumps(_runlog.capture_header("xor_ab")) + "\n")
+            fp.write(json.dumps(_runlog.capture_header(tool)) + "\n")
             for row in rows:
                 fp.write(json.dumps(row) + "\n")
         print(f"xor_ab: capture -> {capture}", file=sys.stderr)

@@ -18,6 +18,18 @@ against table/bitplane/pallas and the native host codec per backend:
   opts in; one-shot CLI invocations keep the free prior.
 * ``RS_STRATEGY_AUTOTUNE=off``: always the static prior (escape hatch).
 
+**Persisted decisions** (docs/XOR.md "The persistent store"): measured
+verdicts also append a ``kind: "rs_autotune"`` record — keyed (host,
+backend, k, p, w) — to the schedule/autotune store
+(:func:`..obs.runlog.store_path`, riding the PR 4 run ledger by
+default).  A fresh process in the default ``prior`` mode resolves from
+the store BEFORE falling back to the static prior, so a restarted
+daemon or a new CLI invocation inherits the measured winner without
+re-probing (``decisions()`` reports those with ``source: "ledger"``).
+``measure`` mode deliberately ignores ledger entries: it re-probes and
+overwrites, so a hardware change re-measures on demand.  Resolution
+sources are counted in ``rs_autotune_source_total{source}``.
+
 Decisions are process-cached and surfaced via :func:`decisions` (the
 ``rs doctor`` strategy section and ``rs stats`` read them).  Mesh
 dispatches never autotune: the mesh path supports a fixed strategy set
@@ -27,6 +39,7 @@ and the collective executable is pinned by its own jit cache.
 from __future__ import annotations
 
 import os
+import socket
 import threading
 import time
 
@@ -44,6 +57,10 @@ VALID_STRATEGIES = ("auto", "bitplane", "table", "pallas", "xor", "cpu")
 _DECISIONS: dict[tuple, dict] = {}
 _LOCK = threading.Lock()
 _MEASURE_LOCK = threading.Lock()  # serializes candidate sweeps
+
+# (backend, k, p, w) -> persisted rs_autotune record for THIS host, lazy-
+# loaded from the store once per process (reset by clear_decisions()).
+_LEDGER_INDEX: dict[tuple, dict] | None = None
 
 _MEASURE_COLS = 256 * 1024  # bytes per chunk in the probe stripe
 _MEASURE_REPS = 3
@@ -98,8 +115,90 @@ def decisions() -> dict:
 
 
 def clear_decisions() -> None:
+    global _LEDGER_INDEX
     with _LOCK:
         _DECISIONS.clear()
+        _LEDGER_INDEX = None  # re-read the store on next resolution
+
+
+def _count_source(source: str) -> None:
+    from .obs import metrics as _metrics
+
+    _metrics.counter(
+        "rs_autotune_source_total",
+        "strategy-auto resolutions by decision source",
+    ).labels(source=source).inc()
+
+
+def _rec_ts(rec: dict) -> float:
+    try:
+        return float(rec.get("ts") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _ledger_decisions() -> dict[tuple, dict]:
+    """Persisted autotune verdicts for THIS host, keyed by (backend, k,
+    p, w) — the NEWEST timestamp wins (not file order: rotation carries
+    old records forward and may interleave them after concurrent fresh
+    appends), so a re-measure supersedes old lines.  Malformed records
+    are skipped, never fatal (the store is a cache)."""
+    global _LEDGER_INDEX
+    with _LOCK:
+        if _LEDGER_INDEX is not None:
+            return _LEDGER_INDEX
+    from .obs import runlog as _runlog
+
+    p = _runlog.store_path()
+    idx: dict[tuple, dict] = {}
+    if p:
+        host = socket.gethostname()
+        for rec in _runlog.read_records(p):
+            if rec.get("kind") != "rs_autotune" or rec.get("host") != host:
+                continue
+            try:
+                key = (str(rec["backend"]), int(rec["k"]), int(rec["p"]),
+                       int(rec["w"]))
+                strategy = str(rec["strategy"])
+            except (KeyError, TypeError, ValueError):
+                continue
+            if strategy not in VALID_STRATEGIES or strategy == "auto":
+                continue
+            cur = idx.get(key)
+            if cur is None or _rec_ts(rec) >= _rec_ts(cur):
+                idx[key] = rec
+    with _LOCK:
+        if _LEDGER_INDEX is None:
+            _LEDGER_INDEX = idx
+        return _LEDGER_INDEX
+
+
+def _persist_decision(decision: dict) -> None:
+    """Best-effort append of a measured verdict to the store."""
+    from .obs import runlog as _runlog
+
+    p = _runlog.store_path()
+    if not p:
+        return
+    rec = {
+        "kind": "rs_autotune",
+        "schema": _runlog.SCHEMA_VERSION,
+        "host": socket.gethostname(),
+        "backend": decision["backend"],
+        "k": decision["k"],
+        "p": decision["p"],
+        "w": decision["w"],
+        "strategy": decision["strategy"],
+        "gbps": decision["gbps"],
+        "ts": decision["ts"],
+        "run": _runlog.run_id(),
+    }
+    _runlog.append(rec, p)
+    key = (decision["backend"], decision["k"], decision["p"],
+           decision["w"])
+    with _LOCK:
+        if _LEDGER_INDEX is not None:
+            _LEDGER_INDEX[key] = rec
 
 
 def _measure_one(strategy: str, A, B, w: int) -> float:
@@ -159,9 +258,12 @@ def autotune_decision(k: int, p: int, w: int = 8,
 
     backend = _backend()
     key = (backend, k, p, w)
+    # A ledger-sourced cache entry never satisfies an explicit measure:
+    # re-probing (and overwriting the persisted record) is the measure
+    # contract — it is how a hardware change invalidates old verdicts.
     with _LOCK:
         hit = _DECISIONS.get(key)
-    if hit is not None:
+    if hit is not None and hit.get("source") == "measured":
         return hit
     # One sweep at a time, re-checked under the lock: concurrent first
     # resolutions of the same class (a daemon's worker pool) must not
@@ -169,7 +271,7 @@ def autotune_decision(k: int, p: int, w: int = 8,
     with _MEASURE_LOCK:
         with _LOCK:
             hit = _DECISIONS.get(key)
-        if hit is not None:
+        if hit is not None and hit.get("source") == "measured":
             return hit
         gf = get_field(w)
         A = generator_matrix(generator, p, k, gf)
@@ -208,8 +310,11 @@ def autotune_decision(k: int, p: int, w: int = 8,
             "rs_strategy_autotune_total",
             "strategy-autotune measurements by backend and winner",
         ).labels(backend=backend, winner=best_name).inc()
+        _count_source("measured")
+        _persist_decision(decision)
         with _LOCK:
-            return _DECISIONS.setdefault(key, decision)
+            _DECISIONS[key] = decision  # overwrite a ledger-sourced entry
+            return decision
 
 
 def resolve_auto(k: int, p: int, w: int = 8, *, mesh=None,
@@ -217,16 +322,47 @@ def resolve_auto(k: int, p: int, w: int = 8, *, mesh=None,
     """Resolve ``strategy="auto"`` for a codec of this shape.
 
     Mesh codecs and ``off`` mode take the static prior; otherwise a
-    cached measured decision wins, and ``measure`` mode creates one on
-    first use per (backend, k, p, w) class.
+    cached measured decision wins, then — in the default ``prior`` mode
+    — a decision persisted in the schedule/autotune store for this
+    (host, backend, k, p, w) class (``source: "ledger"``), then the
+    static prior.  ``measure`` mode re-probes instead of trusting the
+    ledger and overwrites its record.
     """
     if mesh is not None or mode() == "off":
         return static_choice(w)
     backend = _backend()
+    key = (backend, k, p, w)
     with _LOCK:
-        hit = _DECISIONS.get((backend, k, p, w))
-    if hit is not None:
+        hit = _DECISIONS.get(key)
+    if hit is not None and (
+        mode() != "measure" or hit.get("source") == "measured"
+    ):
+        _count_source(hit.get("source") or "measured")
         return hit["strategy"]
     if mode() == "measure":
         return autotune_decision(k, p, w, generator)["strategy"]
+    led = _ledger_decisions().get(key)
+    if led is not None and led["strategy"] not in candidate_strategies(w):
+        # The persisted winner is no longer runnable here (the native
+        # codec was removed, a TPU host became CPU-only): a stale
+        # verdict must not silently route every op onto a fallback
+        # path.  Fall through to the static prior; measure mode
+        # re-probes and overwrites when asked.
+        led = None
+    if led is not None:
+        decision = {
+            "strategy": led["strategy"],
+            "source": "ledger",
+            "backend": backend,
+            "k": k,
+            "p": p,
+            "w": w,
+            "gbps": led.get("gbps"),
+            "ts": led.get("ts"),
+        }
+        with _LOCK:
+            decision = _DECISIONS.setdefault(key, decision)
+        _count_source("ledger")
+        return decision["strategy"]
+    _count_source("prior")
     return static_choice(w)
