@@ -3611,6 +3611,45 @@ def append_file(
     )
 
 
+@_observed_file_op("update_many")
+def update_file_many(
+    file_name: str,
+    edits,
+    *,
+    strategy: str = "auto",
+    segment_bytes: int = DEFAULT_SEGMENT_BYTES,
+    timer: PhaseTimer | None = None,
+    group_edits: int | None = None,
+) -> dict:
+    """Apply an ORDERED batch of edits/appends to one archive under
+    group commit — ``rs update ARCHIVE --edits FILE`` and the daemon's
+    ``/update`` write combining (docs/UPDATE.md "Group commit").
+
+    ``edits`` is a list of dicts: ``{"op": "update", "at": OFF,
+    "data": bytes | "src": path}`` or ``{"op": "append", "data"/"src":
+    ...}``.  Semantically byte-identical to applying the batch one
+    :func:`update_file` / :func:`append_file` call at a time (later
+    edits win overlapping bytes; an edit may target bytes an earlier
+    append in the same batch created) — but the batch merges into
+    touched column windows with ONE stacked ``E·Δ`` GEMM per window
+    block, and commits under ONE journal fsync chain, ONE ``.METADATA``
+    rewrite and ONE generation bump per window group (all-or-nothing:
+    a torn group rolls back every edit via the journal; no edit is
+    acknowledged before its group is durable).  ``RS_UPDATE_GROUP_WINDOW``
+    caps edits per group (larger batches split into consecutive groups);
+    ``group_edits`` overrides it for this call — pass ``len(edits)`` to
+    force the whole batch into ONE all-or-nothing group.  Returns the
+    aggregate summary dict (``edits``, ``groups``, ``windows``,
+    ``segments``, ``chunks_touched``, ``total_size``, ``generation``).
+    """
+    from .update import apply_update_many
+
+    return apply_update_many(
+        file_name, edits, strategy=strategy,
+        segment_bytes=segment_bytes, timer=timer, group_edits=group_edits,
+    )
+
+
 def recover_archive(file_name: str) -> str:
     """Resolve a pending update/append journal next to ``file_name``
     (run automatically at the top of every update/append; exposed for

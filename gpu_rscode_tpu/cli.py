@@ -102,7 +102,11 @@ Subcommands: rs update ARCHIVE --at OFF --in DELTA [--recover] [--json]
             only the touched segment columns move; crash-atomic via the
             undo journal + metadata generation; per-chunk CRCs fixed by
             seekable crc32-combine.  --recover resolves a torn op's
-            journal and exits; docs/UPDATE.md)
+            journal and exits; --edits FILE coalesces a batch of
+            OFFSET:PAYLOADFILE / append:PAYLOADFILE records into
+            group-committed window groups of up to RS_UPDATE_GROUP_WINDOW
+            edits — one journal fsync chain + one metadata commit per
+            group, each group all-or-nothing; docs/UPDATE.md)
             rs append ARCHIVE --in DATA [--json]
             (append-mode encoding: grow the archive without touching
             cold segments — unbounded on interleaved-layout archives,
@@ -454,6 +458,16 @@ def _update_main(argv: list[str], op: str) -> int:
         ap.add_argument("--recover", action="store_true",
                         help="only resolve a pending torn update/append "
                         "journal (rollback), then exit")
+        ap.add_argument("--edits", metavar="FILE", default=None,
+                        help="group-commit batch mode: coalesce the edits "
+                        "listed in FILE into window groups of up to "
+                        "RS_UPDATE_GROUP_WINDOW edits, each group "
+                        "independently all-or-nothing (one journal fsync "
+                        "chain + one metadata commit per group).  One "
+                        "edit per line, OFFSET:PAYLOADFILE for an update "
+                        "or append:PAYLOADFILE for an append; '#' "
+                        "comments and blank lines are skipped; payload "
+                        "paths resolve relative to FILE's directory")
     ap.add_argument("--in", dest="in_path", metavar="FILE", default=None,
                     help=("the replacement bytes" if op == "update"
                           else "the bytes to append"))
@@ -478,21 +492,36 @@ def _update_main(argv: list[str], op: str) -> int:
             print(json.dumps({"archive": args.archive,
                               "recovered": verdict}))
             return 0
-        if args.in_path is None:
-            print(f"rs {op}: --in FILE is required", file=sys.stderr)
-            return 2
-        if op == "update" and args.at is None:
-            print("rs update: --at OFFSET is required", file=sys.stderr)
-            return 2
-        kwargs = dict(src=args.in_path, strategy=args.strategy)
-        if args.segment_bytes:
-            kwargs["segment_bytes"] = args.segment_bytes
         timer = PhaseTimer(enabled=not args.quiet)
-        kwargs["timer"] = timer
-        if op == "update":
-            summary = api.update_file(args.archive, args.at, **kwargs)
+        if op == "update" and args.edits is not None:
+            if args.in_path is not None or args.at is not None:
+                print("rs update: --edits replaces --at/--in (the batch "
+                      "file lists every edit)", file=sys.stderr)
+                return 2
+            try:
+                edits = _parse_edit_lines(args.edits)
+            except (OSError, ValueError) as e:
+                print(f"rs update: bad --edits file: {e}", file=sys.stderr)
+                return 2
+            kwargs = dict(strategy=args.strategy, timer=timer)
+            if args.segment_bytes:
+                kwargs["segment_bytes"] = args.segment_bytes
+            summary = api.update_file_many(args.archive, edits, **kwargs)
         else:
-            summary = api.append_file(args.archive, **kwargs)
+            if args.in_path is None:
+                print(f"rs {op}: --in FILE is required", file=sys.stderr)
+                return 2
+            if op == "update" and args.at is None:
+                print("rs update: --at OFFSET is required", file=sys.stderr)
+                return 2
+            kwargs = dict(src=args.in_path, strategy=args.strategy)
+            if args.segment_bytes:
+                kwargs["segment_bytes"] = args.segment_bytes
+            kwargs["timer"] = timer
+            if op == "update":
+                summary = api.update_file(args.archive, args.at, **kwargs)
+            else:
+                summary = api.append_file(args.archive, **kwargs)
     except (ValueError, FileNotFoundError, OSError) as e:
         print(f"rs {op}: error: {e}", file=sys.stderr)
         return 1
@@ -500,14 +529,58 @@ def _update_main(argv: list[str], op: str) -> int:
         print(json.dumps(summary))
     elif not args.quiet:
         print(f"== {op} {args.archive} ==")
-        print(
-            f"{summary['bytes']} payload bytes -> {summary['segments']} "
-            f"segment block(s), chunks {summary['chunks_touched']}, "
-            f"generation {summary['generation']}, "
-            f"total {summary['total_size']}"
-        )
+        if summary.get("op") == "group":
+            print(
+                f"{summary['edits']} edit(s) in {summary['groups']} "
+                f"group(s) -> {summary['bytes']} payload bytes, "
+                f"{summary['windows']} window(s), {summary['segments']} "
+                f"segment block(s), chunks {summary['chunks_touched']}, "
+                f"generation {summary['generation']}, "
+                f"total {summary['total_size']}"
+            )
+        else:
+            print(
+                f"{summary['bytes']} payload bytes -> {summary['segments']} "
+                f"segment block(s), chunks {summary['chunks_touched']}, "
+                f"generation {summary['generation']}, "
+                f"total {summary['total_size']}"
+            )
         print(timer.summary(data_bytes=summary["bytes"]))
     return 0
+
+
+def _parse_edit_lines(path: str) -> list[dict]:
+    """``--edits`` batch file: one ``OFFSET:PAYLOADFILE`` or
+    ``append:PAYLOADFILE`` record per line (docs/UPDATE.md "Group
+    commit"); payload paths resolve relative to the batch file."""
+    base = os.path.dirname(os.path.abspath(path))
+    edits: list[dict] = []
+    with open(path) as fp:
+        for ln, line in enumerate(fp, 1):
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            head, sep, payload = line.partition(":")
+            if not sep or not payload:
+                raise ValueError(
+                    f"line {ln}: want OFFSET:PAYLOADFILE or "
+                    f"append:PAYLOADFILE, got {line!r}"
+                )
+            src = os.path.join(base, payload.strip())
+            if head.strip() == "append":
+                edits.append({"op": "append", "src": src})
+            else:
+                try:
+                    at = int(head)
+                except ValueError:
+                    raise ValueError(
+                        f"line {ln}: offset {head!r} is not an integer "
+                        "(or the keyword 'append')"
+                    ) from None
+                edits.append({"op": "update", "at": at, "src": src})
+    if not edits:
+        raise ValueError("no edit records (every line blank or comment)")
+    return edits
 
 
 def _fail(msg: str) -> "int":

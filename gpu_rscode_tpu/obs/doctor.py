@@ -162,10 +162,23 @@ def _update_section() -> dict:
         "layouts": [],
         "crash_safety": None,
         "crc_fixup": None,
+        # Group-commit write combining (docs/UPDATE.md "Group commit"):
+        # effective config + process-lifetime tallies, schema-stable.
+        "group_commit": {
+            "available": False,
+            "window_max_edits": None,
+            "groups": 0,
+            "edits": 0,
+            "bytes": 0,
+            "max_group_seen": 0,
+            "journal_fsyncs": 0,
+            "metadata_commits": 0,
+        },
         "error": None,
     }
     try:
         from ..update import apply_append, apply_update  # noqa: F401
+        from ..update import group_stats as _group_stats
 
         out["delta_update"] = True
         out["append"] = True
@@ -174,6 +187,7 @@ def _update_section() -> dict:
             "undo journal + atomic generation-bumped .METADATA rewrite"
         )
         out["crc_fixup"] = "seekable crc32-combine (no full-chunk re-hash)"
+        out["group_commit"].update(available=True, **_group_stats())
     except Exception as e:  # pragma: no cover - import-degraded env
         out["error"] = f"{type(e).__name__}: {e}"
     return out
@@ -475,7 +489,10 @@ def render(report: dict) -> str:
         + (
             f"delta update + append, layouts "
             f"{report['update']['layouts']}, "
-            f"{report['update']['crash_safety']}"
+            f"{report['update']['crash_safety']}; group commit "
+            f"<={report['update']['group_commit']['window_max_edits']} "
+            f"edits/group, {report['update']['group_commit']['groups']} "
+            f"committed (max {report['update']['group_commit']['max_group_seen']})"
             if report["update"]["delta_update"]
             else f"unavailable ({report['update']['error']})"
         ),

@@ -88,6 +88,11 @@ _PINNED_ENV = {
     # The update class drives the crash knob itself, per scheduled op;
     # an ambient value would tear every un-scheduled update too.
     "RS_UPDATE_CRASH": None,
+    # The grouped-update class's torn groups must tear as ONE window
+    # group: an ambient small window would split a scheduled group into
+    # several commits, so the "torn group rolls back ALL edits" check
+    # would see the earlier sub-groups legitimately committed.
+    "RS_UPDATE_GROUP_WINDOW": None,
 }
 
 
@@ -255,6 +260,79 @@ def plan_update_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
         "layout": layout,
         "size": size,
         "events": ops,
+        "faults": faults,
+    }
+
+
+def plan_update_group_iteration(seed: int, i: int,
+                                max_bytes: int = 49152) -> dict:
+    """The GROUPED update workload class (``rs chaos --update --group``):
+    random schedules of group-committed edit batches against one archive
+    — each event is one ``api.update_file_many`` call of 1..6 mixed
+    edits/appends, some torn (RS_UPDATE_CRASH at a random stage
+    mid-group), on its OWN derived seed stream
+    (``rs-chaos-update-group:*`` — the classic/silent/update classes'
+    schedules and digests are untouched by this class existing).
+
+    Validation per group: a torn group must roll back EVERY edit in the
+    batch byte-exactly (one journal covers the whole window group); a
+    committed group must leave the archive byte-identical to applying
+    its edits sequentially (the tracked mirror), healthy under scrub,
+    and — after the whole schedule — chunk- and CRC-identical to a
+    from-scratch re-encode twin of the final logical bytes."""
+    rng = random.Random(f"rs-chaos-update-group:{seed}:{i}")
+    k = rng.randint(2, 6)
+    p = rng.randint(1, 3)
+    w = 16 if rng.random() < 0.2 else 8
+    layout = "interleaved" if rng.random() < 0.6 else "row"
+    size = rng.randint(256, max_bytes)
+    from ..utils.fileformat import chunk_size_for_layout
+
+    chunk0 = chunk_size_for_layout(size, k, w // 8, layout)
+    total = size
+    events = []
+    for _ in range(rng.randint(1, 4)):
+        gtotal = total
+        edits = []
+        for _ in range(rng.randint(1, 6)):
+            kinds = ["update", "update"]
+            if layout == "interleaved":
+                kinds.append("append")
+            elif k * chunk0 - gtotal > 0:
+                kinds.append("append")
+            kind = rng.choice(kinds)
+            if kind == "update":
+                at = rng.randrange(0, gtotal)
+                ln = rng.randint(1, min(2048, gtotal - at))
+                edits.append({"op": "update", "at": at, "len": ln})
+            else:
+                ln = (
+                    rng.randint(1, 2048) if layout == "interleaved"
+                    else rng.randint(1, k * chunk0 - gtotal)
+                )
+                edits.append({"op": "append", "len": ln})
+                gtotal += ln
+        ev = {"group": edits}
+        if rng.random() < 0.35:
+            ev["crash"] = rng.choice(
+                ["after_journal", "mid_patch", "before_commit"]
+            )
+        else:
+            total = gtotal  # only a committed group advances the size
+        events.append(ev)
+    faults = ""
+    if rng.random() < 0.3:
+        faults = "write:delay@ms=1,p=0.05"
+    return {
+        "seed": seed,
+        "iter": i,
+        "mode": "update_group",
+        "k": k,
+        "p": p,
+        "w": w,
+        "layout": layout,
+        "size": size,
+        "events": events,
         "faults": faults,
     }
 
@@ -478,6 +556,8 @@ def run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
             return _run_silent_iteration(cfg, workdir, keep=keep)
         if cfg.get("mode") == "update":
             return _run_update_iteration(cfg, workdir, keep=keep)
+        if cfg.get("mode") == "update_group":
+            return _run_update_group_iteration(cfg, workdir, keep=keep)
         return _run_iteration(cfg, workdir, keep=keep)
 
 
@@ -636,6 +716,158 @@ def _run_update_iteration(cfg: dict, workdir: str, *,
         "k": k, "p": p, "w": w, "size": size,
         "ops": [op["op"] + (":torn" if op.get("crash") else "")
                 for op in cfg["events"]],
+        "final_size": len(mirror),
+        "faults": cfg["faults"], "verdict": "pass",
+    }
+
+
+def _run_update_group_iteration(cfg: dict, workdir: str, *,
+                                keep: bool = False) -> dict:
+    """One grouped-update iteration: encode, run the scheduled sequence
+    of group-committed batches (torn groups included), and prove (a)
+    every torn group rolls back ALL its edits byte-exactly, (b) every
+    committed group equals sequential application (tracked mirror), and
+    (c) the final archive is chunk- and CRC-identical to a from-scratch
+    re-encode twin."""
+    from .. import api
+    from ..update import SimulatedCrash
+    from ..update.journal import journal_path
+    from ..utils.fileformat import (
+        chunk_file_name, metadata_file_name, read_archive_meta,
+    )
+
+    seed, i = cfg["seed"], cfg["iter"]
+    k, p, w, size = cfg["k"], cfg["p"], cfg["w"], cfg["size"]
+    layout = cfg["layout"]
+    base = os.path.join(workdir, f"iter{i}")
+    os.makedirs(base, exist_ok=True)
+    fname = os.path.join(base, f"chaos_group_{i}.bin")
+    data = random.Random(f"rs-chaos-data:{seed}:{i}").randbytes(size)
+    ok = False
+    try:
+        with open(fname, "wb") as fp:
+            fp.write(data)
+        api.encode_file(
+            fname, k, p, checksums=True, w=w, layout=layout,
+            segment_bytes=_SEGMENT_BYTES,
+        )
+        mirror = bytearray(data)
+        plan = (
+            _faults.parse_plan(cfg["faults"], seed=(seed * 1_000_003 + i))
+            if cfg["faults"] else None
+        )
+        _retry.reset_budget()
+        with _faults.activate(plan) if plan else nullcontext():
+            for j, ev in enumerate(cfg["events"]):
+                edits = []
+                for e, op in enumerate(ev["group"]):
+                    payload = random.Random(
+                        f"rs-chaos-group-data:{seed}:{i}:{j}:{e}"
+                    ).randbytes(op["len"])
+                    if op["op"] == "update":
+                        edits.append({"op": "update", "at": op["at"],
+                                      "data": payload})
+                    else:
+                        edits.append({"op": "append", "data": payload})
+                crash = ev.get("crash")
+                if crash:
+                    pre = _archive_snapshot(fname, k + p)
+                    os.environ["RS_UPDATE_CRASH"] = crash
+                    try:
+                        api.update_file_many(
+                            fname, edits, segment_bytes=_SEGMENT_BYTES
+                        )
+                        _check(False, cfg,
+                               f"crash stage {crash} did not fire "
+                               f"(group {j})")
+                    except SimulatedCrash:
+                        pass
+                    finally:
+                        os.environ.pop("RS_UPDATE_CRASH", None)
+                    _check(os.path.exists(journal_path(fname)), cfg,
+                           f"torn group {j} left no journal")
+                    verdict = api.recover_archive(fname)
+                    _check(verdict == "rolled_back", cfg,
+                           f"recovery verdict {verdict!r} on torn "
+                           f"group {j}")
+                    _check(_archive_snapshot(fname, k + p) == pre, cfg,
+                           f"torn group {j} did not roll back ALL "
+                           "edits byte-exact")
+                else:
+                    summary = api.update_file_many(
+                        fname, edits, segment_bytes=_SEGMENT_BYTES
+                    )
+                    _check(summary["groups"] == 1, cfg,
+                           f"group {j} split into {summary['groups']} "
+                           "commits under the pinned window")
+                    # Sequential semantics on the tracked mirror.
+                    for e in edits:
+                        if e["op"] == "update":
+                            at = e["at"]
+                            mirror[at : at + len(e["data"])] = e["data"]
+                        else:
+                            mirror += e["data"]
+                report = api.scan_file(fname, segment_bytes=_SEGMENT_BYTES)
+                _check(
+                    report["decodable"] is True
+                    and not report["corrupt"] and not report["missing"]
+                    and not report["pending_journal"],
+                    cfg, f"archive unhealthy after group {j}: {report}",
+                )
+        twin = os.path.join(base, f"twin_{i}.bin")
+        with open(twin, "wb") as fp:
+            fp.write(bytes(mirror))
+        api.encode_file(
+            twin, k, p, checksums=True, w=w, layout=layout,
+            segment_bytes=_SEGMENT_BYTES,
+        )
+        for c in range(k + p):
+            got = open(chunk_file_name(fname, c), "rb").read()
+            want = open(chunk_file_name(twin, c), "rb").read()
+            _check(got == want, cfg,
+                   f"group-updated chunk {c} != full re-encode twin")
+        ma = read_archive_meta(metadata_file_name(fname))
+        mb = read_archive_meta(metadata_file_name(twin))
+        _check(ma.crcs == mb.crcs and ma.total_size == mb.total_size, cfg,
+               "metadata CRCs/size diverge from the re-encode twin")
+        out = api.auto_decode_file(
+            fname, fname + ".dec", segment_bytes=_SEGMENT_BYTES
+        )
+        _check(open(out, "rb").read() == bytes(mirror), cfg,
+               "decode != tracked logical bytes after the schedule")
+        ok = True
+    except ChaosFailure:
+        raise
+    except Exception as e:
+        raise ChaosFailure(
+            cfg, f"unexpected {type(e).__name__}: {e}"
+        ) from e
+    finally:
+        verdict = "pass" if ok else "fail"
+        _metrics.counter(
+            "rs_chaos_iterations_total", "chaos-harness iteration verdicts"
+        ).labels(verdict=verdict).inc()
+        if _runlog.enabled():
+            _runlog.record({
+                "op": "chaos_iter",
+                "config": {"k": k, "n": k + p, "w": w},
+                "bytes": size,
+                "chaos": {
+                    "seed": seed, "iter": i, "mode": "update_group",
+                    "layout": layout, "events": cfg["events"],
+                    "faults": cfg["faults"],
+                },
+                "outcome": "ok" if ok else "error",
+            })
+        if ok and not keep:
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "iter": i, "mode": "update_group", "layout": layout,
+        "k": k, "p": p, "w": w, "size": size,
+        "groups": [
+            f"{len(ev['group'])}" + (":torn" if ev.get("crash") else "")
+            for ev in cfg["events"]
+        ],
         "final_size": len(mirror),
         "faults": cfg["faults"], "verdict": "pass",
     }
@@ -989,6 +1221,13 @@ def main(argv: list[str] | None = None) -> int:
                     "from-scratch re-encode and every torn op rolled "
                     "back via the journal — own seed stream "
                     "(docs/UPDATE.md)")
+    ap.add_argument("--group", action="store_true",
+                    help="with --update: the GROUPED update class "
+                    "instead — group-committed edit batches "
+                    "(update_file_many), torn groups must roll back ALL "
+                    "their edits byte-exact — own seed stream, plain "
+                    "--update digests unchanged (docs/UPDATE.md "
+                    "\"Group commit\")")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line per iteration")
     ap.add_argument("--keep", action="store_true",
@@ -1015,9 +1254,14 @@ def main(argv: list[str] | None = None) -> int:
             print("rs chaos: --silent and --update conflict; pick one "
                   "workload class", file=sys.stderr)
             return 2
+        if args.group and not args.update:
+            print("rs chaos: --group modifies --update (the grouped "
+                  "update class)", file=sys.stderr)
+            return 2
         indices = [args.only] if args.only is not None else range(args.iters)
         plan = (
-            plan_update_iteration if args.update
+            plan_update_group_iteration if args.update and args.group
+            else plan_update_iteration if args.update
             else plan_silent_iteration if args.silent
             else plan_iteration
         )
@@ -1036,6 +1280,7 @@ def main(argv: list[str] | None = None) -> int:
             print(f"rs chaos: FAILED — {e.what}", file=sys.stderr)
             silent_flag = {
                 "silent": "--silent ", "update": "--update ",
+                "update_group": "--update --group ",
             }.get(cfg.get("mode"), "")
             print(
                 f"rs chaos: replay the original with: rs chaos "

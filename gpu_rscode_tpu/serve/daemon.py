@@ -585,6 +585,7 @@ class ServeDaemon:
         # (docs/XOR.md "The persistent store").
         from .. import tune as _tune
         from ..ops import xor_gemm as _xg
+        from ..update import group_stats as _group_stats
 
         return {
             "queue": self.queue.snapshot(),
@@ -596,6 +597,13 @@ class ServeDaemon:
             "strategies": {
                 "autotune_decisions": _tune.decisions(),
                 "schedule_store": _xg.store_stats(),
+            },
+            # Write-combining facts (docs/UPDATE.md "Group commit"):
+            # config (harvest window, per-group edit cap) next to the
+            # live group-size / fsync tallies.
+            "group_commit": {
+                "window_ms": self.batcher.batch_ms,
+                **_group_stats(),
             },
         }
 
@@ -654,6 +662,19 @@ class ServeDaemon:
                     live.append(req)
             if not live:
                 return
+            if len(live) > 1 and live[0].op in ("update", "append"):
+                # Write combining (docs/UPDATE.md "Group commit"): the
+                # shape key pins these to one (tenant, archive), so the
+                # window's harvest executes as ONE group-committed batch
+                # under the per-name lock — one journal fsync chain, one
+                # metadata rewrite, one generation bump — and every
+                # request acks only after that commit point.
+                if self._run_write_group(live):
+                    return
+                _metrics.counter(
+                    "rs_serve_batch_fallbacks_total",
+                    "batches degraded to per-request execution",
+                ).inc()
             distinct = len({(r.tenant, r.name) for r in live})
             if (len(live) > 1 and distinct == len(live)
                     and live[0].op in ("encode", "decode")):
@@ -682,6 +703,65 @@ class ServeDaemon:
             with self._inflight_cond:
                 self._inflight -= len(group)
                 self._inflight_cond.notify_all()
+
+    def _run_write_group(self, live: list[Request]) -> bool:
+        """One group-committed update/append batch for same-archive write
+        requests, forced into a SINGLE all-or-nothing group
+        (``group_edits=len(edits)`` overrides ``RS_UPDATE_GROUP_WINDOW``)
+        so a failed batch provably committed nothing.  Returns True when
+        every request finished here; False when the caller should fall
+        back to per-request isolation (a single bad edit — e.g. an
+        out-of-range offset — must only fail its own request).  Fallback
+        is only safe when the archive's generation did not move under the
+        failed call — otherwise a solo re-run would apply already-
+        committed edits twice (e.g. the journal unlink failing AFTER the
+        commit point), so those requests fail with the truth instead."""
+        from .. import api
+        from ..utils.fileformat import metadata_file_name, read_archive_meta
+
+        ordered = sorted(live, key=lambda r: r.seq)  # submission order
+        edits = [
+            {"op": r.op, "at": r.at, "src": r.upload} if r.op == "update"
+            else {"op": "append", "src": r.upload}
+            for r in ordered
+        ]
+        lead = ordered[0]
+
+        def _generation():
+            try:
+                return read_archive_meta(
+                    metadata_file_name(lead.spool)).generation
+            except Exception:
+                return None
+
+        try:
+            with self._name_lock((lead.tenant, lead.name)):
+                gen0 = _generation()
+                try:
+                    summary = api.update_file_many(
+                        lead.spool, edits, strategy=lead.strategy,
+                        group_edits=len(edits),
+                    )
+                except Exception as e:
+                    # Fall back ONLY on proof nothing committed: both
+                    # generation reads succeeded and match.  gen0
+                    # unreadable proves the DAEMON'S read failed, not
+                    # that update_file_many's did — a transient error
+                    # there plus a post-commit failure would make a solo
+                    # re-run double-apply.
+                    if gen0 is not None and _generation() == gen0:
+                        return False
+                    for r in ordered:
+                        self.discard_upload(r)
+                        self._finish(r, "error", error=e)
+                    return True
+        except Exception:
+            return False
+        for r in ordered:
+            self.discard_upload(r)
+            self._finish(r, "ok",
+                         result={**summary, "grouped": len(ordered)})
+        return True
 
     def _run_fleet(self, live: list[Request]) -> bool:
         """One warm-executable fleet for a same-shape batch; False when it

@@ -160,9 +160,16 @@ def _schedule(duration_s: float, rate: float, tenants, decode_frac: float,
 def run_open_loop(base_url: str, *, duration_s: float, rate: float,
                   tenants: list[tuple[str, float]], size_bytes: int,
                   k: int, p: int, w: int = 8, decode_frac: float = 0.3,
-                  update_frac: float = 0.0, seed: int = 0,
-                  quiet: bool = False) -> dict:
-    """Drive the daemon at ``base_url``; returns the summary document."""
+                  update_frac: float = 0.0, edit_burst: int = 1,
+                  seed: int = 0, quiet: bool = False) -> dict:
+    """Drive the daemon at ``base_url``; returns the summary document.
+
+    ``edit_burst`` > 1 fires that many concurrent small ``/update``
+    requests per update arrival, all against the same archive — they land
+    inside one ``RS_SERVE_BATCH_MS`` harvest window, so the daemon's
+    write-combining path (docs/UPDATE.md "Group commit") executes them as
+    one group-committed batch and the per-request p50/p99 shows the
+    amortized durability chain."""
     plan = _schedule(duration_s, rate, tenants, decode_frac, seed,
                      update_frac)
     rec = _Recorder()
@@ -198,14 +205,28 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
         elif op == "update":
             # A small hot write against a large cold archive — the
             # workload class rs update exists for.  Deterministic offset
-            # per arrival index keeps the run replayable.
-            at = (i * 7919) % max(1, size_bytes - delta_len + 1)
-            t0 = time.monotonic()
-            status, _ = _post(
-                f"{base_url}/update?name={name}&at={at}", tenant,
-                delta_body)
-            rec.record(tenant, "update", status,
-                       time.monotonic() - t0, delta_len)
+            # per (arrival, burst) index keeps the run replayable.
+            def one_edit(j: int) -> None:
+                at = ((i * 7919) + j * 4099) % max(
+                    1, size_bytes - delta_len + 1)
+                t0 = time.monotonic()
+                status, _ = _post(
+                    f"{base_url}/update?name={name}&at={at}", tenant,
+                    delta_body)
+                rec.record(tenant, "update", status,
+                           time.monotonic() - t0, delta_len)
+            if edit_burst <= 1:
+                one_edit(0)
+            else:
+                # The burst fires concurrently so the whole salvo lands
+                # in one daemon harvest window (write combining).
+                burst = [threading.Thread(target=one_edit, args=(j,),
+                                          daemon=True)
+                         for j in range(edit_burst)]
+                for th in burst:
+                    th.start()
+                for th in burst:
+                    th.join(timeout=180)
         else:
             t0 = time.monotonic()
             status, payload = _post(f"{base_url}/decode?name={name}",
@@ -241,7 +262,8 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
         "config": {"k": k, "n": k + p, "w": w,
                    "size_bytes": size_bytes, "rate": rate,
                    "decode_frac": decode_frac,
-                   "update_frac": update_frac, "seed": seed,
+                   "update_frac": update_frac,
+                   "edit_burst": edit_burst, "seed": seed,
                    "tenants": dict(tenants)},
     }
     if not quiet:
@@ -392,6 +414,11 @@ def main(argv=None) -> int:
                     help="fraction of arrivals that POST /update a small "
                     "byte range of an archive the tenant already encoded "
                     "(mixed read/write traffic; default 0)")
+    ap.add_argument("--edit-burst", type=int, default=1,
+                    help="small /update requests fired CONCURRENTLY per "
+                    "update arrival against the same archive — lands the "
+                    "salvo in one batch window so the daemon's write "
+                    "combining groups it (default 1 = no burst)")
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--n", type=int, default=6)
     ap.add_argument("--w", type=int, default=8, choices=(8, 16))
@@ -470,6 +497,7 @@ def main(argv=None) -> int:
                     size_bytes=args.size_kb * 1024, k=args.k, p=p,
                     w=args.w, decode_frac=args.decode_frac,
                     update_frac=args.update_frac,
+                    edit_burst=max(1, args.edit_burst),
                     seed=args.seed, quiet=args.json)
                 if args.faults:
                     # Self-describing capture: a faulted run's error rows

@@ -95,7 +95,15 @@ class Request:
     def shape_key(self) -> tuple:
         """The plan-cache shape bucket this request dispatches under —
         requests sharing a key share one warm AOT executable, so the
-        batcher coalesces exactly along it."""
+        batcher coalesces exactly along it.  Update/append requests key
+        by (tenant, archive) instead: writes against ONE archive harvested
+        in the same window execute as one group-committed batch (one
+        journal fsync chain + one metadata commit — docs/UPDATE.md
+        "Group commit"), and mixing updates with appends in that group is
+        exactly what the group engine's sequential semantics handle."""
+        if self.op in ("update", "append"):
+            return ("write", self.tenant, self.name, self.k, self.p,
+                    self.w, self.strategy)
         return (self.op, self.k, self.p, self.w, self.strategy,
                 self.generator, self.layout)
 

@@ -1,0 +1,233 @@
+"""``tools/update_group_ab.py`` — N sequential updates vs ONE group.
+
+The acceptance measurement for group-commit write combining
+(docs/UPDATE.md "Group commit"): a burst of N small scattered edits
+applied as one ``api.update_file_many`` window group — one journal fsync
+chain, one metadata commit, one generation bump, one ``E·Δ`` GEMM per
+touched window — must beat the same N edits as N sequential
+``api.update_file`` calls (N full durability chains, N dispatch setups)
+by ≥ 5x on the 64 x 4 KiB / 64 MiB reference config.
+
+A/B discipline (matching tools/update_bench.py): both arms are
+BYTE-VERIFIED first — the sequentially-updated archive, the
+group-updated archive and a from-scratch re-encode twin of the edited
+bytes must agree on every chunk file and every CRC line — then timed as
+paired interleaved best-of-``--trials`` (re-applying the identical edits
+still pays every real cost: journal chains, old reads, dispatches,
+metadata commits; machine noise hits both arms alike).  The capture row
+records both walls, the grouped arm's journal-fsync count (the "one
+chain" claim, falsifiable), and the speedup;
+``bench_captures/update_group_ab_*.jsonl`` joins the BENCH trajectory
+via the shared ``capture_header``.  The daemon-side leg of the same
+story is ``rs loadgen --update-frac F --edit-burst N``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def _edit_plan(size: int, n_edits: int, edit_bytes: int, rng):
+    """N scattered, non-overlapping, deterministic edits: evenly spaced
+    slots with a fixed payload each (distinct column windows — the
+    fsync/dispatch amortization case, not the shared-window one)."""
+    slot = size // n_edits
+    assert slot > edit_bytes, (size, n_edits, edit_bytes)
+    edits = []
+    for j in range(n_edits):
+        at = j * slot + min(slot - edit_bytes, slot // 3)
+        payload = rng.integers(0, 256, size=edit_bytes,
+                               dtype="uint8").tobytes()
+        edits.append({"op": "update", "at": int(at), "data": payload})
+    return edits
+
+
+def _verify(path: str, twin: str, n: int) -> None:
+    from ..utils.fileformat import (
+        chunk_file_name, metadata_file_name, read_archive_meta,
+    )
+
+    for c in range(n):
+        got = open(chunk_file_name(path, c), "rb").read()
+        want = open(chunk_file_name(twin, c), "rb").read()
+        if got != want:
+            raise RuntimeError(f"{path}: chunk {c} != re-encode twin")
+    ma = read_archive_meta(metadata_file_name(path))
+    mb = read_archive_meta(metadata_file_name(twin))
+    if ma.crcs != mb.crcs or ma.total_size != mb.total_size:
+        raise RuntimeError(f"{path}: metadata CRCs/size != twin")
+
+
+def run_ab(
+    *,
+    size_mb: int,
+    n_edits: int,
+    edit_kb: int,
+    k: int,
+    p: int,
+    w: int,
+    layout: str,
+    trials: int,
+    workdir: str,
+    segment_bytes: int | None = None,
+    quiet: bool = False,
+) -> list[dict]:
+    import numpy as np
+
+    from .. import api
+
+    rng = np.random.default_rng(20260804)
+    size = size_mb * 1024 * 1024
+    edit = edit_kb * 1024
+    data = rng.integers(0, 256, size=size, dtype=np.uint8)
+    seq = os.path.join(workdir, f"group_ab_seq_{layout}.bin")
+    grp = os.path.join(workdir, f"group_ab_grp_{layout}.bin")
+    kwargs = {}
+    if segment_bytes:
+        kwargs["segment_bytes"] = segment_bytes
+    for path in (seq, grp):
+        data.tofile(path)
+        api.encode_file(path, k, p, checksums=True, w=w, layout=layout,
+                        **kwargs)
+
+    edits = _edit_plan(size, n_edits, edit, rng)
+
+    # -- byte verification BEFORE any timing: both arms land the same
+    # archive as a from-scratch re-encode of the edited bytes.
+    for e in edits:
+        api.update_file(seq, e["at"], e["data"], **kwargs)
+    summary = api.update_file_many(grp, edits, **kwargs)
+    edited = data.copy()
+    for e in edits:
+        edited[e["at"] : e["at"] + edit] = np.frombuffer(
+            e["data"], dtype=np.uint8)
+    twin = os.path.join(workdir, f"group_ab_twin_{layout}.bin")
+    edited.tofile(twin)
+    api.encode_file(twin, k, p, checksums=True, w=w, layout=layout,
+                    **kwargs)
+    _verify(seq, twin, k + p)
+    _verify(grp, twin, k + p)
+
+    # -- paired interleaved best-of-trials (identical edits re-applied:
+    # every durability chain and dispatch still runs — see module doc).
+    seq_walls, grp_walls = [], []
+    for _ in range(max(1, trials)):
+        t0 = time.perf_counter()
+        for e in edits:
+            api.update_file(seq, e["at"], e["data"], **kwargs)
+        seq_walls.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        summary = api.update_file_many(grp, edits, **kwargs)
+        grp_walls.append(time.perf_counter() - t0)
+
+    s, g = min(seq_walls), min(grp_walls)
+    rows = [
+        {
+            "kind": "update_group_ab",
+            "layout": layout,
+            "size_bytes": size,
+            "edits": n_edits,
+            "edit_bytes": edit,
+            "config": {"k": k, "n": k + p, "w": w},
+            "trials": trials,
+            "sequential_wall_s": round(s, 6),
+            "grouped_wall_s": round(g, 6),
+            "sequential_walls_s": [round(x, 6) for x in seq_walls],
+            "grouped_walls_s": [round(x, 6) for x in grp_walls],
+            "speedup": round(s / g, 3) if g else None,
+            "grouped_groups": summary["groups"],
+            "grouped_windows": summary["windows"],
+            "grouped_segments": summary["segments"],
+            "grouped_journal_fsyncs": summary["journal_fsyncs"],
+            "verified": True,
+        }
+    ]
+    if not quiet:
+        print(
+            f"update_group_ab: {layout} {size_mb}MiB, {n_edits}x"
+            f"{edit_kb}KiB scattered edits -> sequential {s:.4f}s vs "
+            f"grouped {g:.4f}s = {s / g:.1f}x "
+            f"({summary['windows']} windows, "
+            f"{summary['journal_fsyncs']} journal fsync)",
+            file=sys.stderr,
+        )
+    return rows
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    from ..obs import runlog as _runlog
+
+    ap = argparse.ArgumentParser(
+        prog="update_group_ab",
+        description="A/B: N sequential rs-update calls vs one "
+        "group-committed update_file_many batch, both arms byte-verified "
+        "against a re-encode twin before timing (docs/UPDATE.md "
+        "\"Group commit\").",
+    )
+    ap.add_argument("--size-mb", type=int, default=64,
+                    help="archive size in MiB (default 64)")
+    ap.add_argument("--edits", type=int, default=64,
+                    help="scattered edits per burst (default 64)")
+    ap.add_argument("--edit-kb", type=int, default=4,
+                    help="edit size in KiB (default 4)")
+    ap.add_argument("--k", type=int, default=8)
+    ap.add_argument("--p", type=int, default=2)
+    ap.add_argument("--w", type=int, default=8, choices=(8, 16))
+    ap.add_argument("--layouts", default="row,interleaved",
+                    help="comma list of chunk layouts to measure")
+    ap.add_argument("--trials", type=int, default=3)
+    ap.add_argument("--segment-bytes", type=int, default=None)
+    ap.add_argument("--dir", default=None,
+                    help="work directory (default: a fresh temp dir)")
+    ap.add_argument("--capture", default=None,
+                    help="capture JSONL path (default bench_captures/"
+                    "update_group_ab_<backend>_<ts>.jsonl; '-' disables)")
+    ap.add_argument("--json", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return int(e.code or 0)
+
+    rows: list[dict] = []
+    with tempfile.TemporaryDirectory(prefix="rs_update_group_ab_") as tmp:
+        workdir = args.dir or tmp
+        os.makedirs(workdir, exist_ok=True)
+        for layout in [s.strip() for s in args.layouts.split(",") if s]:
+            rows += run_ab(
+                size_mb=args.size_mb, n_edits=args.edits,
+                edit_kb=args.edit_kb, k=args.k, p=args.p, w=args.w,
+                layout=layout, trials=args.trials, workdir=workdir,
+                segment_bytes=args.segment_bytes, quiet=args.json,
+            )
+
+    capture = args.capture
+    if capture is None:
+        os.makedirs("bench_captures", exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+        capture = os.path.join(
+            "bench_captures",
+            f"update_group_ab_{_runlog.backend_name() or 'cpu'}_"
+            f"{stamp}.jsonl",
+        )
+    if capture != "-":
+        with open(capture, "w") as fp:
+            fp.write(
+                json.dumps(_runlog.capture_header("update_group_ab"))
+                + "\n"
+            )
+            for row in rows:
+                fp.write(json.dumps(row) + "\n")
+        print(f"update_group_ab: capture -> {capture}", file=sys.stderr)
+    if args.json:
+        print(json.dumps({"rows": rows, "capture": capture}))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -36,6 +36,7 @@ from .engine import (
     apply_append,
     apply_update,
 )
+from .group import apply_update_many, group_stats, group_window
 from .journal import journal_path, recover
 from .layout import deinterleave, interleave
 
@@ -44,11 +45,14 @@ __all__ = [
     "UpdateError",
     "apply_append",
     "apply_update",
+    "apply_update_many",
     "crc32_append",
     "crc32_combine",
     "crc32_patch",
     "crc32_zeros",
     "deinterleave",
+    "group_stats",
+    "group_window",
     "interleave",
     "journal_path",
     "recover",

@@ -32,6 +32,7 @@ a real crash would — the chaos ``update`` class's torn-op surface.
 
 from __future__ import annotations
 
+import bisect
 import os
 import time
 
@@ -103,33 +104,53 @@ def _block_bytes(k: int, sym: int, segment_bytes: int) -> int:
     return max(sym, (segment_bytes // max(1, k)) // sym * sym)
 
 
-def _assemble_row_block(b0, b1, rows, fps, at, L, payload, chunk, k):
+def _intersecting(spans, starts, flo, fhi):
+    """The ascending, disjoint ``(at, payload)`` spans overlapping file
+    range [flo, fhi), located by bisect — a coalesced group may hold
+    thousands of spans, and a linear scan per block (× per row on the
+    row layout) made assembly O(blocks × rows × edits)."""
+    i = bisect.bisect_right(starts, flo) - 1
+    if i >= 0 and starts[i] + int(spans[i][1].shape[0]) <= flo:
+        i += 1
+    i = max(i, 0)
+    while i < len(spans) and starts[i] < fhi:
+        yield spans[i]
+        i += 1
+
+
+def _assemble_row_block(b0, b1, rows, fps, spans, chunk, k):
     """Row-major Δ for chunk-byte window [b0, b1): per touched row, the
-    intersection of its file range with the edit — old bytes read, new
-    bytes from the payload; untouched rows stay zero."""
+    intersection of its file range with each edit span — old bytes read,
+    new bytes from the span payload; untouched rows stay zero.  ``spans``
+    is an ascending list of disjoint ``(at, payload)`` file ranges (one
+    for a single edit, many for a coalesced group — :mod:`.group`)."""
     delta = np.zeros((k, b1 - b0), dtype=np.uint8)
+    starts = [at for at, _ in spans]
     writes = []
     for r in rows:
-        lo = max(r * chunk + b0, at)
-        hi = min(r * chunk + b1, at + L)
-        if lo >= hi:
-            continue
-        off = lo - r * chunk
-        old = _pread(fps[r], off, hi - lo)
-        new = np.ascontiguousarray(payload[lo - at : hi - at])
-        delta[r, off - b0 : off - b0 + (hi - lo)] = (
-            np.frombuffer(old, dtype=np.uint8) ^ new
-        )
-        writes.append((r, off, old, new.tobytes()))
+        for at, payload in _intersecting(
+            spans, starts, r * chunk + b0, r * chunk + b1
+        ):
+            lo = max(r * chunk + b0, at)
+            hi = min(r * chunk + b1, at + int(payload.shape[0]))
+            if lo >= hi:
+                continue
+            off = lo - r * chunk
+            old = _pread(fps[r], off, hi - lo)
+            new = np.ascontiguousarray(payload[lo - at : hi - at])
+            delta[r, off - b0 : off - b0 + (hi - lo)] = (
+                np.frombuffer(old, dtype=np.uint8) ^ new
+            )
+            writes.append((r, off, old, new.tobytes()))
     return delta, writes
 
 
-def _assemble_interleaved_block(b0, b1, fps, at, L, payload, k, sym):
+def _assemble_interleaved_block(b0, b1, fps, spans, k, sym):
     """Interleaved Δ for chunk-byte window [b0, b1): gather the k old
-    rows, de-interleave to file order, overlay the edit, re-interleave.
-    All rows are candidates (the layout spreads every file byte across
-    rows); rows whose Δ is zero and that gain no extension are dropped
-    by the caller."""
+    rows, de-interleave to file order, overlay every intersecting edit
+    span, re-interleave.  All rows are candidates (the layout spreads
+    every file byte across rows); rows whose Δ is zero and that gain no
+    extension are dropped by the caller."""
     bw = b1 - b0
     old_rows = np.zeros((k, bw), dtype=np.uint8)
     for r in range(k):
@@ -137,11 +158,14 @@ def _assemble_interleaved_block(b0, b1, fps, at, L, payload, k, sym):
         if got:
             old_rows[r, : len(got)] = np.frombuffer(got, dtype=np.uint8)
     file_lo = (b0 // sym) * k * sym
+    file_hi = file_lo + k * bw
     new_file = deinterleave(old_rows, sym).copy()
-    lo = max(file_lo, at)
-    hi = min(file_lo + k * bw, at + L)
-    if lo < hi:
-        new_file[lo - file_lo : hi - file_lo] = payload[lo - at : hi - at]
+    starts = [at for at, _ in spans]
+    for at, payload in _intersecting(spans, starts, file_lo, file_hi):
+        lo = max(file_lo, at)
+        hi = min(file_hi, at + int(payload.shape[0]))
+        if lo < hi:
+            new_file[lo - file_lo : hi - file_lo] = payload[lo - at : hi - at]
     new_rows = interleave(new_file, k, sym)
     delta = old_rows ^ new_rows
     writes = [
@@ -187,8 +211,61 @@ def apply_append(
     )
 
 
-def _apply(file_name, at, payload, *, grow, strategy, segment_bytes, timer):
+def _check_width(meta) -> None:
+    """w=8/16 gate shared by the single-op and group engines."""
+    if meta.w not in (8, 16):
+        raise ValueError(
+            f"unsupported gfwidth {meta.w} in {meta.path!r} "
+            "(this build handles w=8 and w=16 files)"
+        )
+
+
+def _parity_coeffs(meta, gf):
+    """The (p, k) parity coefficient block ``E`` from the archive's
+    (systematic) total matrix — validated, shared by both engines."""
     from ..models.vandermonde import total_matrix as _regen_total
+
+    k = meta.native_num
+    mat = meta.total_mat
+    if mat is None:
+        mat = _regen_total(meta.parity_num, k, gf)
+    mat = np.asarray(mat)
+    if int(mat.max(initial=0)) >= (1 << meta.w):
+        raise ValueError(
+            f"metadata matrix entry {int(mat.max())} out of range for "
+            f"GF(2^{meta.w}) — corrupt or foreign .METADATA"
+        )
+    if not np.array_equal(mat[:k], np.eye(k, dtype=mat.dtype)):
+        raise UpdateError(
+            "delta update needs a systematic total matrix (identity "
+            "native block); this archive's metadata is foreign — "
+            "re-encode instead"
+        )
+    return mat[k:].astype(gf.dtype)
+
+
+def _open_chunks(file_name, all_idx, chunk_old, fps) -> None:
+    """Open every chunk in ``all_idx`` r+b into the caller's ``fps`` dict
+    (caller owns closing — including on partial failure here), refusing
+    missing or truncated chunks with the actionable repair hint."""
+    for idx in all_idx:
+        path = chunk_file_name(file_name, idx)
+        try:
+            fps[idx] = open(path, "r+b")
+        except FileNotFoundError:
+            raise UpdateError(
+                f"chunk {idx} ({path!r}) is missing — repair the "
+                "archive (rs --repair -i) before updating it"
+            ) from None
+        size = os.fstat(fps[idx].fileno()).st_size
+        if size < chunk_old:
+            raise UpdateError(
+                f"chunk {idx} ({path!r}) is truncated ({size} of "
+                f"{chunk_old} bytes) — repair the archive first"
+            )
+
+
+def _apply(file_name, at, payload, *, grow, strategy, segment_bytes, timer):
     from ..ops.gf import get_field
 
     timer = timer or PhaseTimer(enabled=False)
@@ -199,11 +276,7 @@ def _apply(file_name, at, payload, *, grow, strategy, segment_bytes, timer):
     meta_path = metadata_file_name(file_name)
     meta = read_archive_meta(meta_path)
     k, p, w = meta.native_num, meta.parity_num, meta.w
-    if w not in (8, 16):
-        raise ValueError(
-            f"unsupported gfwidth {w} in {meta_path!r} "
-            "(this build handles w=8 and w=16 files)"
-        )
+    _check_width(meta)
     sym = meta.sym
     total = meta.total_size
     L = int(payload.shape[0])
@@ -225,22 +298,7 @@ def _apply(file_name, at, payload, *, grow, strategy, segment_bytes, timer):
         )
 
     gf = get_field(w)
-    mat = meta.total_mat
-    if mat is None:
-        mat = _regen_total(p, k, gf)
-    mat = np.asarray(mat)
-    if int(mat.max(initial=0)) >= (1 << w):
-        raise ValueError(
-            f"metadata matrix entry {int(mat.max())} out of range for "
-            f"GF(2^{w}) — corrupt or foreign .METADATA"
-        )
-    if not np.array_equal(mat[:k], np.eye(k, dtype=mat.dtype)):
-        raise UpdateError(
-            "delta update needs a systematic total matrix (identity "
-            "native block); this archive's metadata is foreign — "
-            "re-encode instead"
-        )
-    E = mat[k:].astype(gf.dtype)
+    E = _parity_coeffs(meta, gf)
 
     chunk_old = meta.chunk
     new_total = total + L if grow else None
@@ -265,21 +323,7 @@ def _apply(file_name, at, payload, *, grow, strategy, segment_bytes, timer):
 
     fps: dict[int, object] = {}
     try:
-        for idx in all_idx:
-            path = chunk_file_name(file_name, idx)
-            try:
-                fps[idx] = open(path, "r+b")
-            except FileNotFoundError:
-                raise UpdateError(
-                    f"chunk {idx} ({path!r}) is missing — repair the "
-                    "archive (rs --repair -i) before updating it"
-                ) from None
-            size = os.fstat(fps[idx].fileno()).st_size
-            if size < chunk_old:
-                raise UpdateError(
-                    f"chunk {idx} ({path!r}) is truncated ({size} of "
-                    f"{chunk_old} bytes) — repair the archive first"
-                )
+        _open_chunks(file_name, all_idx, chunk_old, fps)
 
         codec = RSCodec(k, p, w=w, strategy=strategy)
         crcs = dict(meta.crcs) if meta.crcs else None
@@ -291,12 +335,13 @@ def _apply(file_name, at, payload, *, grow, strategy, segment_bytes, timer):
         committed = False
         try:
             step = _block_bytes(k, sym, segment_bytes)
+            spans = [(at, payload)]
             with DrainExecutor(ordered=True, name="rs-io-patch") as lane:
                 for wlo, whi in windows:
                     for b0 in range(wlo, whi, step):
                         b1 = min(b0 + step, whi)
                         blocks += _patch_block(
-                            b0, b1, step, rows, fps, at, L, payload,
+                            b0, b1, step, rows, fps, spans,
                             chunk_old, k, p, sym, meta.layout, codec, E,
                             lane, jr, crcs, touched, timer,
                             first=blocks == 0, op=op,
@@ -353,29 +398,16 @@ def _apply(file_name, at, payload, *, grow, strategy, segment_bytes, timer):
     }
 
 
-def _patch_block(
-    b0, b1, cap_bytes, rows, fps, at, L, payload, chunk_old, k, p, sym,
-    layout, codec, E, lane, jr, crcs, touched, timer, *, first, op,
-) -> int:
-    """One column block: assemble Δ, dispatch E·Δ, journal, patch natives
-    + parity, account CRCs.  Returns 1 (blocks counted by the caller)."""
-    with timer.phase("update stage (io)"):
-        if layout == "interleaved":
-            delta, native_writes = _assemble_interleaved_block(
-                b0, b1, fps, at, L, payload, k, sym
-            )
-        else:
-            delta, native_writes = _assemble_row_block(
-                b0, b1, rows, fps, at, L, payload, chunk_old, k
-            )
-
-    with timer.phase("update dispatch"), _tracing.span(
-        "dispatch", lane="dispatch", op=op, off=int(b0), cols=int(b1 - b0)
-    ):
-        staged = codec.stage_segment(
-            delta, cap=cap_bytes // sym, sym=sym, out_rows=p
-        )
-        pd = codec.update(E, staged)  # async E·Δ through the plan cache
+def _collect_block(
+    b0, b1, delta, native_writes, pd, fps, chunk_old, k, p,
+    layout, timer,
+):
+    """Finish one block's write set from its parity delta ``pd`` (the
+    single-op engine's async ``E·Δ`` handle, or the group plane's slice
+    of a stacked multi-window result): XOR the delta into the old parity
+    bytes, drop untouched interleaved native rows.  Returns the ordered
+    ``(idx, off, old, new)`` write list (natives first, then parity) and
+    the native-write count."""
     with timer.phase("update compute"):
         pd_np = np.asarray(pd)
     if pd_np.dtype != np.uint8:
@@ -397,8 +429,49 @@ def _patch_block(
             wrt for r, wrt in enumerate(native_writes)
             if ext or delta[r].any()
         ]
+    return native_writes + parity_writes, len(native_writes)
 
-    writes = native_writes + parity_writes
+
+def _stage_block(
+    b0, b1, cap_bytes, rows, fps, spans, chunk_old, k, p, sym,
+    layout, codec, E, timer, *, op,
+):
+    """One column block's write set: assemble the block's Δ from the
+    edit spans, dispatch ``E·Δ`` through the plan cache, and
+    :func:`_collect_block` the result."""
+    with timer.phase("update stage (io)"):
+        if layout == "interleaved":
+            delta, native_writes = _assemble_interleaved_block(
+                b0, b1, fps, spans, k, sym
+            )
+        else:
+            delta, native_writes = _assemble_row_block(
+                b0, b1, rows, fps, spans, chunk_old, k
+            )
+
+    with timer.phase("update dispatch"), _tracing.span(
+        "dispatch", lane="dispatch", op=op, off=int(b0), cols=int(b1 - b0)
+    ):
+        staged = codec.stage_segment(
+            delta, cap=cap_bytes // sym, sym=sym, out_rows=p
+        )
+        pd = codec.update(E, staged)  # async E·Δ through the plan cache
+    return _collect_block(
+        b0, b1, delta, native_writes, pd, fps, chunk_old, k, p,
+        layout, timer,
+    )
+
+
+def _patch_block(
+    b0, b1, cap_bytes, rows, fps, spans, chunk_old, k, p, sym,
+    layout, codec, E, lane, jr, crcs, touched, timer, *, first, op,
+) -> int:
+    """One column block: assemble Δ, dispatch E·Δ, journal, patch natives
+    + parity, account CRCs.  Returns 1 (blocks counted by the caller)."""
+    writes, n_native = _stage_block(
+        b0, b1, cap_bytes, rows, fps, spans, chunk_old, k, p, sym,
+        layout, codec, E, timer, op=op,
+    )
     # Undo bytes FIRST, durably — only then may any region change
     # (the write-ahead discipline recovery depends on).
     for idx, off, old, _new in writes:
@@ -407,7 +480,7 @@ def _patch_block(
     if first:
         _crash_point("after_journal")
     for pos, (idx, off, old, new) in enumerate(writes):
-        if first and pos == len(native_writes):
+        if first and pos == n_native:
             # Natives patched, parity not yet — the torn state the
             # journal exists for.
             lane.flush()

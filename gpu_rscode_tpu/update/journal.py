@@ -87,13 +87,16 @@ class Journal:
         self._fp.write(old)
         self._dirty = True
 
-    def sync(self) -> None:
+    def sync(self) -> bool:
         """Barrier: every queued record is durable before the engine may
-        patch the regions it covers."""
+        patch the regions it covers.  Returns True when an fsync was
+        actually issued (the group-commit fsync accounting reads this)."""
         if self._dirty:
             self._fp.flush()
             os.fsync(self._fp.fileno())
             self._dirty = False
+            return True
+        return False
 
     def close(self, *, commit: bool) -> None:
         """``commit=True`` (metadata rename landed) discards the journal;

@@ -560,6 +560,171 @@ def test_serve_encode_rejects_bad_layout(daemon):
     assert st == 400 and b"layout" in body
 
 
+def test_serve_write_combining_groups_same_archive(tmp_path):
+    """Concurrent small /update requests against ONE archive harvested in
+    the same batch window execute as ONE group-committed batch
+    (docs/UPDATE.md "Group commit"): every request acks 200 with the
+    shared group summary, the decoded archive equals sequential
+    application, and /stats reports the group tallies."""
+    from gpu_rscode_tpu.update import group_stats
+
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=150,
+                    workers=2)
+    d.start()
+    try:
+        rng = np.random.default_rng(33)
+        data = rng.integers(0, 256, size=300000, dtype=np.uint8).tobytes()
+        st, _ = _post(d.port, "/encode?name=wc.bin&k=4&n=6", data)
+        assert st == 200
+        stats0 = group_stats()
+        results = []
+        lock = threading.Lock()
+
+        def upd(j):
+            st, body = _post(d.port, f"/update?name=wc.bin&at={j * 10000}",
+                             bytes([j + 1]) * 500)
+            with lock:
+                results.append((j, st, json.loads(body)))
+
+        threads = [threading.Thread(target=upd, args=(j,))
+                   for j in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        grouped = 0
+        for j, st, body in results:
+            assert st == 200, (j, st, body)
+            grouped = max(grouped, body["update"].get("grouped", 1))
+        assert grouped > 1, "no write combining in the batch window"
+        stats1 = group_stats()
+        assert stats1["edits"] > stats0["edits"]
+        mirror = bytearray(data)
+        for j in range(8):
+            mirror[j * 10000 : j * 10000 + 500] = bytes([j + 1]) * 500
+        st, body = _post(d.port, "/decode?name=wc.bin")
+        assert st == 200 and body == bytes(mirror)
+        st, body = _get(d.port, "/stats")
+        gc = json.loads(body)["group_commit"]
+        assert gc["window_ms"] == 150 and gc["groups"] >= 1
+        assert gc["max_group_seen"] >= grouped
+        assert gc["window_max_edits"] >= 1
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_serve_write_group_bad_edit_isolated(tmp_path):
+    """A poisoned edit in a combined write batch must not take its
+    batchmates down: the group falls back to per-request isolation, the
+    good edits land, only the bad one 500s."""
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=150,
+                    workers=2)
+    d.start()
+    try:
+        rng = np.random.default_rng(34)
+        data = rng.integers(0, 256, size=50000, dtype=np.uint8).tobytes()
+        st, _ = _post(d.port, "/encode?name=iso.bin&k=4&n=6", data)
+        assert st == 200
+        results = []
+        lock = threading.Lock()
+
+        def upd(j, at):
+            st, body = _post(d.port, f"/update?name=iso.bin&at={at}",
+                             bytes([j + 1]) * 100)
+            with lock:
+                results.append((j, st, body))
+
+        threads = [
+            threading.Thread(target=upd, args=(0, 1000)),
+            threading.Thread(target=upd, args=(1, 10 ** 9)),  # poisoned
+            threading.Thread(target=upd, args=(2, 2000)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        by_j = {j: (st, body) for j, st, body in results}
+        assert by_j[0][0] == 200 and by_j[2][0] == 200
+        assert by_j[1][0] == 500 and b"append" in by_j[1][1]
+        mirror = bytearray(data)
+        mirror[1000:1100] = b"\x01" * 100
+        mirror[2000:2100] = b"\x03" * 100
+        st, body = _post(d.port, "/decode?name=iso.bin")
+        assert st == 200 and body == bytes(mirror)
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_serve_write_group_poison_no_double_apply(tmp_path, monkeypatch):
+    """The combiner forces its harvest into ONE all-or-nothing group
+    (``group_edits=len(edits)``), so a tiny ambient
+    RS_UPDATE_GROUP_WINDOW cannot partially commit a poisoned salvo
+    before the isolation fallback re-runs every request — the good
+    appends must land exactly ONCE (a prefix group committing first
+    would double-append them through the fallback)."""
+    monkeypatch.setenv("RS_UPDATE_GROUP_WINDOW", "1")
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=300,
+                    workers=2)
+    d.start()
+    try:
+        rng = np.random.default_rng(35)
+        data = rng.integers(0, 256, size=60000, dtype=np.uint8).tobytes()
+        st, _ = _post(d.port,
+                      "/encode?name=dd.bin&k=4&n=6&layout=interleaved",
+                      data)
+        assert st == 200
+        results = []
+        lock = threading.Lock()
+
+        def run(j, path, payload, delay):
+            time.sleep(delay)
+            st, body = _post(d.port, path, payload)
+            with lock:
+                results.append((j, st, body))
+
+        threads = [
+            threading.Thread(target=run, args=(
+                0, "/append?name=dd.bin", b"\xA1" * 400, 0.0)),
+            threading.Thread(target=run, args=(
+                1, "/append?name=dd.bin", b"\xB2" * 400, 0.04)),
+            threading.Thread(target=run, args=(
+                2, f"/update?name=dd.bin&at={10 ** 9}", b"z", 0.08)),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        by_j = {j: (st, body) for j, st, body in results}
+        assert by_j[0][0] == 200 and by_j[1][0] == 200
+        assert by_j[2][0] == 500
+        st, body = _post(d.port, "/decode?name=dd.bin")
+        assert st == 200
+        assert len(body) == len(data) + 800, "append applied != once"
+        assert body[:len(data)] == data
+        assert sorted(body[len(data):]) == sorted(
+            b"\xA1" * 400 + b"\xB2" * 400)
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_loadgen_edit_burst_schedule_and_flag():
+    """--edit-burst leaves the seeded arrival schedule untouched (bursts
+    expand at fire time, not in the plan) and the flag parses."""
+    from gpu_rscode_tpu.serve.loadgen import _schedule
+
+    plan = _schedule(30.0, 10.0, [("a", 1.0)], decode_frac=0.2,
+                     seed=9, update_frac=0.5)
+    again = _schedule(30.0, 10.0, [("a", 1.0)], decode_frac=0.2,
+                      seed=9, update_frac=0.5)
+    assert plan == again  # burst is orthogonal to the schedule
+
+
 def test_loadgen_update_schedule_mix():
     """--update-frac draws update arrivals (seeded, replayable) and the
     three op kinds partition the stream."""

@@ -465,6 +465,287 @@ def test_cli_layout_flag_validation(tmp_path, capsys):
     capsys.readouterr()
 
 
+# ----- group commit (update_file_many) ---------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["row", "interleaved"])
+@pytest.mark.parametrize("w", [8, 16])
+def test_update_many_matches_sequential(tmp_path, layout, w):
+    """Overlap semantics pin: grouped application is byte-identical to
+    applying the same ordered edits one op at a time — overlapping,
+    adjacent and duplicate-offset edits, chunk-seam spans and the ragged
+    tail included, on both layouts x both widths."""
+    rng = np.random.default_rng(30)
+    data = rng.integers(0, 256, size=30011, dtype=np.uint8).tobytes()
+    seq = _encode(tmp_path, f"gs_{layout}_{w}.bin", data, layout=layout,
+                  w=w)
+    grp = _encode(tmp_path, f"gg_{layout}_{w}.bin", data, layout=layout,
+                  w=w)
+    edits = [
+        {"op": "update", "at": 100, "data": b"\x01" * 300},
+        {"op": "update", "at": 250, "data": b"\x02" * 100},   # overlap
+        {"op": "update", "at": 400, "data": b"\x03" * 50},    # adjacent
+        {"op": "update", "at": 100, "data": b"\x04" * 10},    # dup offset
+        {"op": "update", "at": 29990, "data": b"\x05" * 21},  # ragged tail
+        {"op": "update", "at": 7000, "data": b"\x06" * 4097}, # chunk seam
+    ]
+    if layout == "interleaved":
+        edits += [
+            {"op": "append", "data": b"\x07" * 777},
+            # an edit of bytes the PREVIOUS append in the batch created
+            {"op": "update", "at": 30011 + 100, "data": b"\x08" * 20},
+        ]
+    for e in edits:
+        if e["op"] == "update":
+            api.update_file(seq, e["at"], e["data"], segment_bytes=SEG)
+        else:
+            api.append_file(seq, e["data"], segment_bytes=SEG)
+    summary = api.update_file_many(grp, edits, segment_bytes=SEG)
+    assert summary["op"] == "group" and summary["groups"] == 1
+    assert summary["edits"] == len(edits)
+    assert _chunks(seq, 6) == _chunks(grp, 6)
+    ma = read_archive_meta(metadata_file_name(seq))
+    mb = read_archive_meta(metadata_file_name(grp))
+    assert ma.crcs == mb.crcs and ma.total_size == mb.total_size
+    assert mb.generation == 1  # ONE bump for the whole group
+    assert _decode_bytes(seq) == _decode_bytes(grp)
+
+
+def test_update_many_one_fsync_chain_per_group(tmp_path):
+    """The group-commit acceptance contract: N scattered edits commit
+    under ONE journal fsync + ONE metadata rewrite (asserted via
+    rs_update_group_fsyncs_total), with one generation bump."""
+    from gpu_rscode_tpu.obs import metrics as obs_metrics
+    from gpu_rscode_tpu.update import group_stats
+
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, size=60000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "fsync.bin", data, layout="interleaved")
+    forced = obs_metrics.forced()
+    obs_metrics.force_enable()
+    try:
+        def counts():
+            snap = obs_metrics.REGISTRY.snapshot().get(
+                "rs_update_group_fsyncs_total", {})
+            return dict(snap.get("values", {}))
+
+        before = counts()
+        edits = [
+            {"op": "update", "at": j * 7000, "data": bytes([j]) * 512}
+            for j in range(8)
+        ]
+        stats0 = group_stats()
+        summary = api.update_file_many(path, edits, segment_bytes=SEG)
+        stats1 = group_stats()
+        after = counts()
+        assert summary["groups"] == 1 and summary["journal_fsyncs"] == 1
+        assert stats1["groups"] == stats0["groups"] + 1
+        assert stats1["edits"] == stats0["edits"] + 8
+        assert stats1["journal_fsyncs"] == stats0["journal_fsyncs"] + 1
+        assert stats1["metadata_commits"] == stats0["metadata_commits"] + 1
+        assert stats1["max_group_seen"] >= 8
+
+        def delta(stage):
+            return sum(val - before.get(key, 0)
+                       for key, val in after.items() if stage in key)
+
+        assert delta("journal") == 1, (before, after)
+        assert delta("metadata") == 1, (before, after)
+        assert read_archive_meta(
+            metadata_file_name(path)).generation == 1
+    finally:
+        obs_metrics.force_enable(forced)
+    mirror = bytearray(data)
+    for j in range(8):
+        mirror[j * 7000 : j * 7000 + 512] = bytes([j]) * 512
+    assert _decode_bytes(path) == bytes(mirror)
+
+
+def test_update_many_group_window_splits(tmp_path, monkeypatch):
+    """RS_UPDATE_GROUP_WINDOW caps edits per commit group: a larger
+    batch splits into consecutive groups (one generation bump each),
+    still byte-equal to sequential application."""
+    rng = np.random.default_rng(32)
+    data = rng.integers(0, 256, size=20000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "win.bin", data)
+    monkeypatch.setenv("RS_UPDATE_GROUP_WINDOW", "2")
+    edits = [{"op": "update", "at": j * 3000, "data": bytes([j + 1]) * 100}
+             for j in range(5)]
+    summary = api.update_file_many(path, edits, segment_bytes=SEG)
+    assert summary["groups"] == 3 and summary["edits"] == 5
+    assert summary["generation"] == 3
+    mirror = bytearray(data)
+    for j in range(5):
+        mirror[j * 3000 : j * 3000 + 100] = bytes([j + 1]) * 100
+    assert _decode_bytes(path) == bytes(mirror)
+
+
+def test_update_many_group_edits_override(tmp_path, monkeypatch):
+    """``group_edits=`` overrides RS_UPDATE_GROUP_WINDOW for one call:
+    the daemon's write combiner passes the whole batch so its harvest
+    commits as ONE all-or-nothing group — a failed batch must commit
+    NOTHING (the isolation fallback re-runs every edit solo, so a
+    partial commit would double-apply)."""
+    rng = np.random.default_rng(35)
+    data = rng.integers(0, 256, size=20000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "ge.bin", data, layout="interleaved")
+    monkeypatch.setenv("RS_UPDATE_GROUP_WINDOW", "2")
+    edits = [{"op": "append", "data": bytes([j + 1]) * 300}
+             for j in range(5)]
+    summary = api.update_file_many(path, edits, segment_bytes=SEG,
+                                   group_edits=len(edits))
+    assert summary["groups"] == 1 and summary["generation"] == 1
+    pre = _chunks(path, 6)
+    with pytest.raises(UpdateError, match="edit 2"):
+        api.update_file_many(path, [
+            {"op": "append", "data": b"x" * 200},
+            {"op": "append", "data": b"y" * 200},
+            {"op": "update", "at": 10 ** 9, "data": b"z"},
+        ], segment_bytes=SEG, group_edits=3)
+    # Despite the window=2 ambient knob, no prefix group committed.
+    assert _chunks(path, 6) == pre
+    assert read_archive_meta(metadata_file_name(path)).generation == 1
+    mirror = bytearray(data)
+    for j in range(5):
+        mirror += bytes([j + 1]) * 300
+    assert _decode_bytes(path) == bytes(mirror)
+
+
+def test_update_many_error_indexes_are_batch_relative(tmp_path,
+                                                      monkeypatch):
+    """A bad edit past the first window group reports its position in
+    the CALLER'S batch (the --edits file line an operator must fix), not
+    its index within the split group."""
+    rng = np.random.default_rng(36)
+    data = rng.integers(0, 256, size=10000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "bi.bin", data)
+    monkeypatch.setenv("RS_UPDATE_GROUP_WINDOW", "2")
+    edits = [{"op": "update", "at": j * 1000, "data": b"a" * 50}
+             for j in range(4)]
+    edits.append({"op": "update", "at": 10 ** 9, "data": b"b"})
+    with pytest.raises(UpdateError, match="edit 4"):
+        api.update_file_many(path, edits, segment_bytes=SEG)
+
+
+@pytest.mark.parametrize("stage",
+                         ["after_journal", "mid_patch", "before_commit"])
+def test_torn_group_rolls_back_every_edit(tmp_path, monkeypatch, stage):
+    """All-or-nothing: a group torn at any crash stage rolls back EVERY
+    edit in the window group byte-exactly via the single journal."""
+    rng = np.random.default_rng(33)
+    data = rng.integers(0, 256, size=25000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, f"tg_{stage}.bin", data,
+                   layout="interleaved")
+    pre = _chunks(path, 6) + [open(metadata_file_name(path), "rb").read()]
+    edits = [
+        {"op": "update", "at": 100, "data": b"\xAA" * 2000},
+        {"op": "update", "at": 20000, "data": b"\xBB" * 3000},
+        {"op": "append", "data": b"\xCC" * 5000},
+    ]
+    monkeypatch.setenv("RS_UPDATE_CRASH", stage)
+    with pytest.raises(SimulatedCrash):
+        api.update_file_many(path, edits, segment_bytes=SEG)
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    assert os.path.exists(ujournal.journal_path(path))
+    assert api.recover_archive(path) == "rolled_back"
+    post = _chunks(path, 6) + [open(metadata_file_name(path), "rb").read()]
+    assert post == pre
+    assert _decode_bytes(path) == data
+
+
+def test_update_many_validation_is_all_or_nothing(tmp_path):
+    """A bad edit anywhere in the batch (validated against the RUNNING
+    total its predecessors left) applies nothing; empty batches and
+    zero-length payloads are clean no-ops."""
+    rng = np.random.default_rng(34)
+    data = rng.integers(0, 256, size=5000, dtype=np.uint8).tobytes()
+    path = _encode(tmp_path, "val.bin", data)
+    pre = _chunks(path, 6)
+    with pytest.raises(UpdateError, match="edit 1"):
+        api.update_file_many(path, [
+            {"op": "update", "at": 0, "data": b"x" * 10},
+            {"op": "update", "at": 10 ** 9, "data": b"y"},
+        ], segment_bytes=SEG)
+    assert _chunks(path, 6) == pre
+    assert not os.path.exists(ujournal.journal_path(path))
+    res = api.update_file_many(path, [], segment_bytes=SEG)
+    assert res["edits"] == 0 and res["segments"] == 0
+    assert res["generation"] == 0
+    res = api.update_file_many(
+        path, [{"op": "update", "at": 0, "data": b""}], segment_bytes=SEG)
+    assert res["segments"] == 0 and res["generation"] == 0
+    with pytest.raises(ValueError, match="edit 0"):
+        api.update_file_many(path, [{"op": "frobnicate", "data": b"x"}])
+    with pytest.raises(ValueError, match="'at'"):
+        api.update_file_many(path, [{"op": "update", "data": b"x"}])
+
+
+def test_cli_update_edits_batch_file(tmp_path, capsys):
+    """rs update --edits FILE: OFFSET:PAYLOADFILE / append:PAYLOADFILE
+    records apply as one group (payload paths relative to the batch
+    file)."""
+    rng = np.random.default_rng(35)
+    data = rng.integers(0, 256, size=12000, dtype=np.uint8).tobytes()
+    path = str(tmp_path / "cli_group.bin")
+    open(path, "wb").write(data)
+    assert cli.main(["-k", "4", "-n", "6", "--checksum", "--layout",
+                     "interleaved", "--quiet", "-e", path]) == 0
+    open(str(tmp_path / "d1.bin"), "wb").write(b"\x11" * 200)
+    open(str(tmp_path / "d2.bin"), "wb").write(b"\x22" * 300)
+    open(str(tmp_path / "tail.bin"), "wb").write(b"\x33" * 500)
+    edits_file = str(tmp_path / "edits.txt")
+    open(edits_file, "w").write(
+        "# one edit per line\n"
+        "1000:d1.bin\n"
+        "\n"
+        "5000:d2.bin\n"
+        "append:tail.bin\n"
+    )
+    assert cli.main(["update", path, "--edits", edits_file,
+                     "--json"]) == 0
+    summary = json.loads(capsys.readouterr().out)
+    assert summary["op"] == "group" and summary["edits"] == 3
+    assert summary["generation"] == 1 and summary["total_size"] == 12500
+    mirror = bytearray(data)
+    mirror[1000:1200] = b"\x11" * 200
+    mirror[5000:5300] = b"\x22" * 300
+    mirror += b"\x33" * 500
+    assert _decode_bytes(path) == bytes(mirror)
+    # --edits conflicts with --at/--in; bad record lines are usage errors
+    assert cli.main(["update", path, "--edits", edits_file,
+                     "--at", "0"]) == 2
+    bad = str(tmp_path / "bad.txt")
+    open(bad, "w").write("notanoffset:d1.bin\n")
+    assert cli.main(["update", path, "--edits", bad]) == 2
+    capsys.readouterr()
+
+
+def test_update_group_ab_capture_schema(tmp_path):
+    """Tiny in-process run of tools/update_group_ab.py: capture_header
+    first line, byte-verified arms, speedup recorded (the CI update-smoke
+    group leg validates the same schema)."""
+    from gpu_rscode_tpu.tools.update_group_ab import main as ab_main
+
+    capture = str(tmp_path / "gcap.jsonl")
+    rc = ab_main([
+        "--size-mb", "1", "--edits", "8", "--edit-kb", "2",
+        "--trials", "1", "--k", "4", "--p", "2",
+        "--dir", str(tmp_path / "work"), "--capture", capture, "--json",
+    ])
+    assert rc == 0
+    rows = [json.loads(line) for line in open(capture)]
+    assert rows[0]["kind"] == "capture_header"
+    assert rows[0]["tool"] == "update_group_ab"
+    ab = [r for r in rows if r["kind"] == "update_group_ab"]
+    assert len(ab) >= 1
+    for r in ab:
+        assert r["verified"] is True
+        assert r["sequential_wall_s"] > 0 and r["grouped_wall_s"] > 0
+        assert r["speedup"] is not None
+        assert r["edits"] == 8
+        assert r["grouped_journal_fsyncs"] == 1
+
+
 # ----- A/B bench capture contract --------------------------------------------
 
 
