@@ -3620,6 +3620,8 @@ def update_file_many(
     segment_bytes: int = DEFAULT_SEGMENT_BYTES,
     timer: PhaseTimer | None = None,
     group_edits: int | None = None,
+    group_tag: str | None = None,
+    stage_hook=None,
 ) -> dict:
     """Apply an ORDERED batch of edits/appends to one archive under
     group commit — ``rs update ARCHIVE --edits FILE`` and the daemon's
@@ -3641,12 +3643,16 @@ def update_file_many(
     force the whole batch into ONE all-or-nothing group.  Returns the
     aggregate summary dict (``edits``, ``groups``, ``windows``,
     ``segments``, ``chunks_touched``, ``total_size``, ``generation``).
+    ``group_tag`` / ``stage_hook`` are the daemon write combiner's
+    lifecycle joins (group id in span + summary; ``device_done`` stage
+    callback — update/group.py).
     """
     from .update import apply_update_many
 
     return apply_update_many(
         file_name, edits, strategy=strategy,
         segment_bytes=segment_bytes, timer=timer, group_edits=group_edits,
+        group_tag=group_tag, stage_hook=stage_hook,
     )
 
 

@@ -143,19 +143,29 @@ Subcommands: rs update ARCHIVE --at OFF --in DELTA [--recover] [--json]
             serve-daemon health, roofline freshness)
             rs serve [--root DIR] [--port P] [--addr A] [--depth N]
             [--batch-ms MS] [--max-batch N] [--workers N]
-            [--warm K,N[,W]] [--faults SPEC]
+            [--warm K,N[,W]] [--faults SPEC] [--slo SPEC]
             (resident multi-tenant encode/decode daemon: POST /encode
             /decode /scrub with streaming bodies, X-RS-Tenant fairness,
             429 past RS_SERVE_DEPTH, cross-request batching into the
-            warm plan cache, graceful drain on SIGTERM; docs/SERVE.md)
+            warm plan cache, graceful drain on SIGTERM; every response
+            echoes X-RS-Request-Id, GET /slo + /debug/requests expose
+            the request lifecycle plane; docs/SERVE.md)
             rs loadgen [--url U | --spawn] [--duration S] [--rate R]
             [--tenants a:3,b:1] [--size-kb N] [--decode-frac F]
             [--update-frac F] [--k K] [--n N] [--seed S] [--ab --files N]
-            [--faults SPEC] [--capture PATH] [--json]
+            [--faults SPEC] [--slo SPEC] [--capture PATH] [--json]
             (open-loop Poisson load harness for rs serve: offered vs
             achieved throughput, per-tenant latency percentiles, bench
-            capture; --ab times resident-daemon vs CLI-subprocess-per-
-            file on the same encode workload)
+            capture with per-request ids + stage breakdowns; --slo
+            configures objectives on the spawned daemon and exits 4 on
+            a missed window — open-loop runs double as SLO gates; --ab
+            times resident-daemon vs CLI-subprocess-per-file)
+            rs slo [--url U | --runlog PATH [--slo SPEC]] [--check]
+            [--json]
+            (per-tenant SLO attainment + burn rates over rolling
+            windows: scrape a live daemon's GET /slo, or replay
+            kind=rs_request ledger records offline; --check exits 4
+            on any missed objective; docs/SERVE.md)
             RS_PROFILE=DIR wraps every file operation (scrub/fleet/chaos
             included) in a jax.profiler capture; --profile-dir is the
             per-run alias
@@ -621,6 +631,10 @@ def main(argv: list[str] | None = None) -> int:
         from .serve.loadgen import main as _loadgen_main
 
         return _loadgen_main(argv[1:])
+    if argv and argv[0] == "slo":
+        from .obs.slo import main as _slo_main
+
+        return _slo_main(argv[1:])
     if argv and argv[0] in ("update", "append"):
         return _update_main(argv[1:], argv[0])
     try:

@@ -30,6 +30,9 @@ Sections:
 * ``serve`` — the resident daemon (docs/SERVE.md): configured port and
   queue/batch knobs, plus a live ``/healthz`` probe of a running
   daemon (queue depth, draining state).
+* ``slo`` — per-tenant SLO objectives (``RS_SLO``, obs/slo.py): the
+  parsed objective table, rolling-window config, and — when a daemon
+  is configured and probing is on — its live ``/slo`` breach summary.
 * ``roofline`` — per-host calibration from the ledger and its age vs
   ``RS_ROOFLINE_MAX_AGE_S`` (obs/attrib.py).
 
@@ -54,7 +57,7 @@ SCHEMA_VERSION = 1
 # The --json document's stable surface (pinned by tests): these keys are
 # always present, whatever the environment looks like.
 SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "update",
-            "strategies", "ledger", "metrics_endpoint", "serve",
+            "strategies", "ledger", "metrics_endpoint", "serve", "slo",
             "roofline")
 
 
@@ -382,6 +385,68 @@ def _serve_section(probe: bool = True) -> dict:
     return out
 
 
+def _slo_section(probe: bool = True) -> dict:
+    """SLO-objective facts (docs/SERVE.md "Request lifecycle"): the
+    parsed ``RS_SLO`` table and rolling-window config, plus one live
+    ``GET /slo`` probe of a configured daemon summarizing current
+    breaches.  A malformed spec surfaces here as the parse error the
+    daemon would refuse to start with."""
+    from . import slo as _slo
+
+    out: dict = {
+        "configured": False,
+        "source": None,  # "env" | "daemon" (rs serve --slo)
+        "spec": os.environ.get("RS_SLO") or None,
+        "objectives": [],
+        "windows_s": list(_slo.windows()),
+        "reqtrace_ring": None,
+        "attainment": None,
+        "error": None,
+    }
+    try:
+        from . import reqtrace as _reqtrace
+
+        out["reqtrace_ring"] = _reqtrace.ring_capacity()
+    except Exception:
+        pass
+    if out["spec"]:
+        try:
+            objectives = _slo.parse_slo(out["spec"])
+        except _slo.SLOSpecError as e:
+            out["error"] = f"SLOSpecError: {e}"
+            return out
+        out["configured"] = True
+        out["source"] = "env"
+        out["objectives"] = [o.describe() for o in objectives]
+    port = os.environ.get("RS_SERVE_PORT")
+    if probe and port:
+        # Probe regardless of the env spec: a daemon started with
+        # `rs serve --slo ...` is configured even when this shell's
+        # RS_SLO is unset — its /slo report is the truth.
+        try:
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{int(port)}/slo", timeout=2
+            ) as resp:
+                report = json.loads(resp.read())
+            if report.get("configured") and not out["configured"]:
+                out["configured"] = True
+                out["source"] = "daemon"
+                out["objectives"] = report.get("objectives", [])
+            if report.get("configured"):
+                out["attainment"] = {
+                    "cells": len(report.get("cells", [])),
+                    "breaches": _slo.breaches(report),
+                }
+        except Exception as e:
+            if out["configured"]:
+                out["error"] = f"{type(e).__name__}: {e}"
+    if not out["configured"] and out["error"] is None:
+        out["error"] = "RS_SLO unset (no SLO objectives)"
+    return out
+
+
 def _roofline_section(ledger_records: list[dict]) -> dict:
     out: dict = {"cached": False, "age_s": None, "fresh": None,
                  "triad_gbps": None, "gemm_gflops": None,
@@ -429,6 +494,7 @@ def collect(probe_endpoint: bool = True) -> dict:
         "ledger": ledger,
         "metrics_endpoint": _endpoint_section(probe_endpoint),
         "serve": _serve_section(probe_endpoint),
+        "slo": _slo_section(probe_endpoint),
         "roofline": _roofline_section(ledger_records),
     }
     warnings = []
@@ -463,7 +529,25 @@ def render(report: dict) -> str:
     led = report["ledger"]
     ep = report["metrics_endpoint"]
     sv = report["serve"]
+    sl = report["slo"]
     rl = report["roofline"]
+    if not sl["configured"]:
+        slo_line = f"[--] slo: RS_SLO unset (ring {sl['reqtrace_ring']})"
+        if sl["spec"]:  # set but unparseable — that IS a problem
+            slo_line = f"[!!] slo: {sl['error']}"
+    else:
+        n_breach = (len(sl["attainment"]["breaches"])
+                    if sl["attainment"] else None)
+        spec = sl["spec"] if sl["source"] == "env" \
+            else "from the live daemon"
+        slo_line = (
+            f"[{mark(not n_breach)}] slo: "
+            f"{len(sl['objectives'])} objective(s) ({spec}), "
+            f"windows {sl['windows_s']}"
+            + (f"; live: {sl['attainment']['cells']} cell(s), "
+               f"{n_breach} breach(es)" if sl["attainment"] is not None
+               else "; not probed")
+        )
     lines = [
         f"rs doctor @ {report['host']} "
         f"(python {report['python']['version']})",
@@ -544,6 +628,7 @@ def render(report: dict) -> str:
            if sv["port"] else "RS_SERVE_PORT unset")
         + f"; knobs depth={sv['depth']} batch_ms={sv['batch_ms']} "
           f"max_batch={sv['max_batch']} workers={sv['workers']}",
+        slo_line,
         f"[{mark(rl['cached'] and rl['fresh'])}] roofline: "
         + (f"{rl['triad_gbps']} GB/s triad / {rl['gemm_gflops']} GFLOP/s "
            f"gemm, age {rl['age_s']}s "

@@ -477,11 +477,13 @@ def filter_records(
     raw capture file); config filters compare against the record's
     ``config`` dict and skip records that lack the field only when the
     filter asks for it.  Capture headers, roofline-calibration records
-    (``rs_roofline``, obs/attrib.py) and persistent-store records
-    (``rs_xor_schedule``/``rs_autotune``, ops/xor_gemm.py + tune.py) are
-    dropped — they are identity/calibration/cache state, not
-    measurements, and must not occupy trend-window slots or print as
-    junk rows.
+    (``rs_roofline``, obs/attrib.py), persistent-store records
+    (``rs_xor_schedule``/``rs_autotune``, ops/xor_gemm.py + tune.py)
+    and per-request lifecycle events (``rs_request``, obs/reqtrace.py —
+    their wall includes queue/batch wait, so trending them as op
+    throughput would corrupt regression baselines; ``rs slo --runlog``
+    is their reader) are dropped — none of them are op measurements,
+    and they must not occupy trend-window slots or print as junk rows.
     """
     out = []
     header_tool = None
@@ -490,7 +492,7 @@ def filter_records(
             header_tool = r.get("tool")
             continue
         if r.get("kind") in ("rs_roofline", "rs_xor_schedule",
-                             "rs_autotune"):
+                             "rs_autotune", "rs_request"):
             continue
         cfg = r.get("config") or {}
         if op is not None and op not in (

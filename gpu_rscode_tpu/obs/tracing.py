@@ -69,6 +69,11 @@ class Tracer:
         self.path = path
         self._events: deque = deque()
         self._t0 = time.perf_counter()
+        # Monotonic clock at t0: anchors retroactive events recorded
+        # from time.monotonic() stamps (the request lifecycle plane,
+        # obs/reqtrace.py) onto this tracer's timeline without assuming
+        # perf_counter and monotonic share an epoch.
+        self._mono_t0 = time.monotonic()
         # Wall clock at t0: lets the aggregator place this trace's
         # relative timestamps on a shared cross-host axis.
         self.wall_t0 = time.time()
@@ -129,6 +134,24 @@ class Tracer:
             "pid": 1,
             "args": values,
         })
+
+    def complete(self, name: str, lane: str, t0_mono: float,
+                 t1_mono: float, **args) -> None:
+        """Record a complete ("X") event RETROACTIVELY from a pair of
+        ``time.monotonic()`` stamps — the request lifecycle plane stamps
+        stages as a request flows and emits the spans once, at ack, so
+        every span carries the finished request's identity args."""
+        ev = {
+            "name": name,
+            "ph": "X",
+            "ts": (t0_mono - self._mono_t0) * 1e6,
+            "dur": max(0.0, (t1_mono - t0_mono) * 1e6),
+            "pid": 1,
+            "tid": self._tid(lane),
+        }
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
 
     # -- export --------------------------------------------------------------
 
@@ -234,6 +257,15 @@ def counter(name: str, **values) -> None:
     t = _ACTIVE
     if t is not None:
         t.counter(name, **values)
+
+
+def complete(name: str, lane: str, t0_mono: float, t1_mono: float,
+             **args) -> None:
+    """Retroactive complete event on the active session from
+    ``time.monotonic()`` stamps; no-op when tracing is off."""
+    t = _ACTIVE
+    if t is not None:
+        t.complete(name, lane, t0_mono, t1_mono, **args)
 
 
 def traced(name: str | None = None, lane: str = "host"):

@@ -25,6 +25,7 @@ from __future__ import annotations
 import threading
 import time
 
+from ..obs import reqtrace as _reqtrace
 from ..utils.env import float_env as _float_env, int_env as _int_env
 from .queue import AdmissionQueue, Request
 
@@ -57,6 +58,7 @@ class Batcher:
         self.batches = 0
         self.coalesced = 0  # requests that rode along in a batch of > 1
         self.max_batch_seen = 0
+        self._batch_seq = 0  # batch-id source (unique per formed batch)
 
     def next_batches(self, timeout: float | None = None) \
             -> list[list[Request]] | None:
@@ -81,10 +83,18 @@ class Batcher:
         for req in window:
             groups.setdefault(req.shape_key(), []).append(req)
         batches = list(groups.values())
+        formed = time.monotonic()
         with self._lock:
             self.windows += 1
             self.batches += len(batches)
             for b in batches:
+                self._batch_seq += 1
+                for req in b:
+                    # Batch identity + the batch_formed stage stamp: the
+                    # window just closed, so every member shares one
+                    # instant (the lifecycle plane's batch-wait boundary).
+                    req.batch_id = self._batch_seq
+                    _reqtrace.mark(req, "batch_formed", formed)
                 if len(b) > 1:
                     self.coalesced += len(b)
                 self.max_batch_seen = max(self.max_batch_seen, len(b))

@@ -60,7 +60,14 @@ from concurrent.futures import ThreadPoolExecutor
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
-from ..obs import metrics as _metrics, runlog as _runlog
+from ..obs import (
+    metrics as _metrics,
+    reqtrace as _reqtrace,
+    runlog as _runlog,
+    slo as _slo,
+    tracing as _tracing,
+)
+from ..utils.timing import PhaseTimer
 from .batcher import Batcher
 from .queue import AdmissionQueue, Draining, QueueFull, Request
 
@@ -112,6 +119,12 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        # EVERY response of a request-bearing path echoes the request id
+        # (docs/SERVE.md "Request lifecycle") — 400/404/429/503/504/500
+        # rejections included, so client logs stay joinable.
+        rid = getattr(self, "_rs_req_id", None)
+        if rid:
+            self.send_header("X-RS-Request-Id", rid)
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -131,10 +144,16 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (http.server naming)
         url = urlparse(self.path)
+        # GETs are not requests in the lifecycle sense: clear any id a
+        # previous POST on this keep-alive connection left behind.
+        self._rs_req_id = None
         try:
             if url.path == "/healthz":
                 self._send_json(200, self.daemon.health())
             elif url.path == "/metrics":
+                # Rolling SLO windows age out without new traffic, so
+                # the rs_slo_* gauges refresh at scrape time.
+                self.daemon.slo.export_gauges()
                 body = _metrics.REGISTRY.render_text().encode()
                 self.send_response(200)
                 self.send_header(
@@ -145,6 +164,20 @@ class _Handler(BaseHTTPRequestHandler):
                 self.wfile.write(body)
             elif url.path == "/stats":
                 self._send_json(200, self.daemon.stats())
+            elif url.path == "/slo":
+                # Per-tenant attainment + burn rates (obs/slo.py); the
+                # export also refreshes the rs_slo_* gauges.
+                self._send_json(200, self.daemon.slo.export_gauges())
+            elif url.path == "/debug/requests":
+                query = parse_qs(url.query)
+                try:
+                    n = int(_q1(query, "n", "50") or 50)
+                except ValueError:
+                    n = 50
+                self._send_json(200, {
+                    "ring": _reqtrace.ring_capacity(),
+                    "requests": _reqtrace.recent(n),
+                })
             else:
                 self._send_error_json(404, f"no such path {url.path}")
         except BrokenPipeError:
@@ -155,6 +188,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_POST(self) -> None:  # noqa: N802
         url = urlparse(self.path)
         query = parse_qs(url.query)
+        # Request identity FIRST: the client's X-RS-Request-Id (when it
+        # validates) or a minted one — echoed on every outcome path,
+        # before any parsing can fail (obs/reqtrace.py).
+        self._rs_req_id = _reqtrace.accept_request_id(
+            self.headers.get("X-RS-Request-Id"))
         try:
             if url.path not in (
                 "/encode", "/decode", "/scrub", "/update", "/append"
@@ -168,7 +206,13 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if req is None:
                 return  # error response already sent
-            self._respond(req)
+            status = None
+            try:
+                status = self._respond(req)
+            finally:
+                # Ack boundary: response bytes written (or the client
+                # went away — status None); fold the lifecycle event.
+                self.daemon.finish_request(req, status)
         except BrokenPipeError:
             pass
         except Exception as e:  # defense: a handler bug must answer 500
@@ -240,6 +284,7 @@ class _Handler(BaseHTTPRequestHandler):
                 checksums=_q1(query, "checksum", "1") != "0",
                 keep=_q1(query, "keep", "0") == "1",
                 layout=enc_layout, cost=nbytes, deadline=deadline,
+                req_id=self._rs_req_id,
             )
             req.upload = upload
         elif op in ("update", "append"):
@@ -275,6 +320,7 @@ class _Handler(BaseHTTPRequestHandler):
                 op, tenant, name, spool, k=k, p=p, w=w,
                 strategy=_q1(query, "strategy", "auto"),
                 at=at, cost=nbytes, deadline=deadline,
+                req_id=self._rs_req_id,
             )
             req.upload = upload
         else:
@@ -301,36 +347,51 @@ class _Handler(BaseHTTPRequestHandler):
                 strategy=_q1(query, "strategy", "auto"),
                 syndrome=_q1(query, "syndrome", "0") == "1",
                 cost=total, deadline=deadline,
+                req_id=self._rs_req_id,
             )
 
+        _reqtrace.begin(req)  # lifecycle timeline anchored at admission
         try:
             daemon.queue.submit(req)
         except QueueFull as e:
             daemon.discard_upload(req)
             self._send_error_json(429, str(e), {"Retry-After": "1"})
+            daemon.finish_request(req, 429)
             return None
         except Draining as e:
             daemon.discard_upload(req)
             self._send_error_json(503, str(e), {"Retry-After": "5"})
+            daemon.finish_request(req, 503)
             return None
         return req
 
-    def _respond(self, req: Request) -> None:
+    def _respond(self, req: Request) -> int | None:
+        """Send the response for an executed request; returns the HTTP
+        status written (the ack-boundary emit's outcome field)."""
         if not req.done.wait(self.daemon.request_timeout_s):
             self._send_error_json(
                 500, f"request timed out after "
                 f"{self.daemon.request_timeout_s}s in the daemon")
-            return
+            return 500
         base = {
             "ok": req.outcome == "ok",
             "op": req.op, "tenant": req.tenant, "name": req.name,
+            "req_id": req.req_id,
             "batch": req.batch_size,
             "queue_wait_ms": round(req.queue_wait_s * 1e3, 3),
             "service_ms": round(req.service_s * 1e3, 3),
         }
+        stages = _reqtrace.stage_offsets(req)
+        if stages is not None:
+            # The stage timeline so far (ack lands after this write):
+            # offsets in ms since admission, consecutive and summing to
+            # the request wall (docs/SERVE.md "Request lifecycle").
+            base["stages_ms"] = {
+                s: round(v * 1e3, 3) for s, v in stages.items()}
         if req.outcome == "expired":
             self._send_json(504, {
                 **base, "error": "deadline exceeded before execution"})
+            return 504
         elif req.outcome != "ok":
             self._send_json(500, {
                 **base,
@@ -338,6 +399,7 @@ class _Handler(BaseHTTPRequestHandler):
                 "error_type": type(req.error).__name__
                 if req.error else None,
             })
+            return 500
         elif req.op == "decode":
             out_path = req.result
             try:
@@ -346,6 +408,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self.send_header("Content-Type", "application/octet-stream")
                 self.send_header("Content-Length", str(size))
                 self.send_header("X-RS-Batch", str(req.batch_size))
+                self.send_header("X-RS-Request-Id", req.req_id)
+                if stages is not None:
+                    # Decode streams bytes, not JSON — the breakdown
+                    # rides a header so loadgen captures stay complete.
+                    self.send_header("X-RS-Stages", json.dumps(stages))
                 self.end_headers()
                 with open(out_path, "rb") as fp:
                     while True:
@@ -360,6 +427,7 @@ class _Handler(BaseHTTPRequestHandler):
                     os.unlink(out_path)
                 except OSError:
                     pass
+            return 200
         else:
             payload = dict(base)
             if req.op == "encode":
@@ -371,6 +439,7 @@ class _Handler(BaseHTTPRequestHandler):
             else:  # scrub
                 payload["report"] = req.result
             self._send_json(200, payload)
+            return 200
 
 
 class ServeDaemon:
@@ -385,7 +454,8 @@ class ServeDaemon:
                  batch_ms: float | None = None, max_batch: int | None = None,
                  workers: int | None = None,
                  request_timeout_s: float | None = None,
-                 max_body: int | None = None):
+                 max_body: int | None = None,
+                 slo_spec: str | None = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.addr = addr if addr is not None else os.environ.get(
@@ -423,6 +493,10 @@ class ServeDaemon:
         self._name_locks: dict[tuple, threading.Lock] = {}
         self._name_locks_guard = threading.Lock()
         self._upload_ids = itertools.count(1)
+        # Per-tenant SLO objectives (obs/slo.py): RS_SLO by default,
+        # --slo / slo_spec= override.  An empty engine costs nothing.
+        self.slo = _slo.SLOEngine(spec=slo_spec)
+        self._trace_cm = None  # daemon-lifetime RS_TRACE session
         self._started = time.time()
         self._closed = False
         self.requests_done = 0
@@ -490,6 +564,14 @@ class ServeDaemon:
     def start(self) -> "ServeDaemon":
         # A daemon without metrics would serve an empty /metrics forever.
         _metrics.force_enable()
+        # With RS_TRACE set, the daemon OWNS one lifetime trace session
+        # (exported at close): per-op sessions join it (sessions are
+        # reentrant), so one Perfetto file covers the whole serving run
+        # and the ack-time request spans (obs/reqtrace.py) always find
+        # an active tracer — per-op sessions would already be closed.
+        if os.environ.get("RS_TRACE"):
+            self._trace_cm = _tracing.session()
+            self._trace_cm.__enter__()
         self._pool = ThreadPoolExecutor(
             max_workers=self.workers, thread_name_prefix="rs-serve-exec")
         self._sched_thread = threading.Thread(
@@ -558,6 +640,11 @@ class ServeDaemon:
             self._serve_thread.join(5)
         if self._sched_thread is not None:
             self._sched_thread.join(5)
+        if self._trace_cm is not None:
+            # Export the daemon-lifetime trace after every thread that
+            # could still be recording spans has joined.
+            self._trace_cm.__exit__(None, None, None)
+            self._trace_cm = None
 
     # -- introspection -------------------------------------------------------
 
@@ -605,6 +692,16 @@ class ServeDaemon:
                 "window_ms": self.batcher.batch_ms,
                 **_group_stats(),
             },
+            # Lifecycle plane config (docs/SERVE.md "Request lifecycle").
+            "slo": {
+                "configured": bool(self.slo.objectives),
+                "objectives": [o.describe() for o in self.slo.objectives],
+                "windows_s": list(self.slo.windows),
+            },
+            "reqtrace": {
+                "enabled": _reqtrace.enabled(),
+                "ring": _reqtrace.ring_capacity(),
+            },
         }
 
     # -- scheduling / execution ----------------------------------------------
@@ -622,9 +719,53 @@ class ServeDaemon:
             if self.queue.draining and not self.queue.depth():
                 return  # drained dry — scheduler done
 
+    def finish_request(self, req: Request, status: int | None) -> None:
+        """The ack boundary, called by the HANDLER after the response
+        bytes are written (admission rejections included): stamp ``ack``,
+        fold the wide lifecycle event (ring + ledger + stage quantiles +
+        trace spans — obs/reqtrace.py), and feed the SLO engine with the
+        user-visible wall (admission to response)."""
+        now = time.monotonic()
+        _reqtrace.mark(req, "ack", now)
+        _reqtrace.emit(req, status=status)
+        if status is not None:
+            # status None = the CLIENT went away mid-response (broken
+            # pipe): no user-visible outcome exists, and an impatient
+            # load generator must not burn the daemon's availability
+            # budget — the wide event above still records the abort
+            # (outcome with status null).
+            self.slo.observe(req.tenant, req.op, now - req.arrival,
+                             ok=(status == 200), t=now)
+
+    @staticmethod
+    def _mark_device_done(req: Request, timer: PhaseTimer) -> None:
+        """Derived device/drain boundary for the pipelined file ops:
+        their writes OVERLAP compute (write-behind, docs/IO.md), so no
+        single instant separates the two — the stamp is now minus the
+        op's accumulated write-phase wall, clamped to the dispatch stamp
+        so the timeline stays monotonic.  The write-group path stamps
+        the true boundary instead (update/group.py stage hook)."""
+        if req.stages is None or not timer.enabled:
+            return
+        now = time.monotonic()
+        write_s = sum(v for name, v in timer.acc.items()
+                      if name.startswith("write") and name.endswith("(io)"))
+        _reqtrace.mark(req, "device_done",
+                       min(now, max(req.t_dispatch, now - write_s)))
+
     def _finish(self, req: Request, outcome: str, result=None,
                 error: BaseException | None = None) -> None:
-        req.service_s = time.monotonic() - req.arrival - req.queue_wait_s
+        now = time.monotonic()
+        # Service time stamped directly at the execution boundary, not
+        # derived by subtraction: dispatch -> completion, EXCLUDING the
+        # batch-form/slot waits and the response write (the old
+        # arrival-minus-queue-wait formula folded both in, overstating
+        # device time for every batched request).
+        if req.t_dispatch:
+            req.service_s = now - req.t_dispatch
+            _reqtrace.mark(req, "drain_done", now)
+        else:  # never dispatched (expired in the batch window)
+            req.service_s = 0.0
         _metrics.counter(
             "rs_serve_requests_total", "serve requests by outcome",
         ).labels(op=req.op, tenant=req.tenant, outcome=outcome).inc()
@@ -662,6 +803,12 @@ class ServeDaemon:
                     live.append(req)
             if not live:
                 return
+            t_disp = time.monotonic()
+            for req in live:
+                # Execution starts here — the service_s anchor (always
+                # stamped; the stage dict only when the plane is on).
+                req.t_dispatch = t_disp
+                _reqtrace.mark(req, "dispatch", t_disp)
             if len(live) > 1 and live[0].op in ("update", "append"):
                 # Write combining (docs/UPDATE.md "Group commit"): the
                 # shape key pins these to one (tenant, archive), so the
@@ -726,6 +873,19 @@ class ServeDaemon:
             for r in ordered
         ]
         lead = ordered[0]
+        # Group <-> request-id join (docs/SERVE.md "Request lifecycle"):
+        # ONE group id covers the whole combined commit; every member
+        # still acks under its own request id, and the group engine tags
+        # its dispatch span + summary with the group id so the commit is
+        # attributable from either side.
+        group_id = f"wg-{_reqtrace.new_request_id()}"
+        for r in ordered:
+            r.group_id = group_id
+
+        def _stage(stage: str) -> None:
+            now = time.monotonic()
+            for r in ordered:
+                _reqtrace.mark(r, stage, now)
 
         def _generation():
             try:
@@ -740,7 +900,8 @@ class ServeDaemon:
                 try:
                     summary = api.update_file_many(
                         lead.spool, edits, strategy=lead.strategy,
-                        group_edits=len(edits),
+                        group_edits=len(edits), group_tag=group_id,
+                        stage_hook=_stage,
                     )
                 except Exception as e:
                     # Fall back ONLY on proof nothing committed: both
@@ -750,17 +911,22 @@ class ServeDaemon:
                     # there plus a post-commit failure would make a solo
                     # re-run double-apply.
                     if gen0 is not None and _generation() == gen0:
+                        for r in ordered:  # rerun solo — not this group
+                            r.group_id = None
                         return False
                     for r in ordered:
                         self.discard_upload(r)
                         self._finish(r, "error", error=e)
                     return True
         except Exception:
+            for r in ordered:
+                r.group_id = None
             return False
         for r in ordered:
             self.discard_upload(r)
             self._finish(r, "ok",
-                         result={**summary, "grouped": len(ordered)})
+                         result={**summary, "grouped": len(ordered),
+                                 "group_id": group_id})
         return True
 
     def _run_fleet(self, live: list[Request]) -> bool:
@@ -812,6 +978,10 @@ class ServeDaemon:
     def _run_solo(self, req: Request) -> None:
         from .. import api
 
+        # Phase accounting feeds the derived device/drain stage boundary
+        # (_mark_device_done); disabled with the lifecycle plane so the
+        # hot path pays nothing extra when telemetry is off.
+        timer = PhaseTimer(enabled=req.stages is not None)
         try:
             with self._name_lock((req.tenant, req.name)):
                 if req.op == "encode":
@@ -820,14 +990,16 @@ class ServeDaemon:
                         req.spool, req.k, req.p,
                         generator=req.generator,
                         strategy=req.strategy, checksums=req.checksums,
-                        w=req.w, layout=req.layout,
+                        w=req.w, layout=req.layout, timer=timer,
                     )
+                    self._mark_device_done(req, timer)
                     self._finish_encode(req, files)
                 elif req.op == "decode":
                     out = api.auto_decode_file(
                         req.spool, self._decode_out(req),
-                        strategy=req.strategy,
+                        strategy=req.strategy, timer=timer,
                     )
+                    self._mark_device_done(req, timer)
                     self._finish(req, "ok", result=out)
                 elif req.op in ("update", "append"):
                     # The upload temp IS the payload (never promoted onto
@@ -835,13 +1007,14 @@ class ServeDaemon:
                     if req.op == "update":
                         summary = api.update_file(
                             req.spool, req.at, src=req.upload,
-                            strategy=req.strategy,
+                            strategy=req.strategy, timer=timer,
                         )
                     else:
                         summary = api.append_file(
                             req.spool, src=req.upload,
-                            strategy=req.strategy,
+                            strategy=req.strategy, timer=timer,
                         )
+                    self._mark_device_done(req, timer)
                     self.discard_upload(req)
                     self._finish(req, "ok", result=summary)
                 else:  # scrub
@@ -895,6 +1068,10 @@ def main(argv=None) -> int:
                     help="activate the deterministic fault plane for the "
                     "daemon's lifetime (same grammar as RS_FAULTS; "
                     "docs/RESILIENCE.md)")
+    ap.add_argument("--slo", metavar="SPEC", default=None,
+                    help="per-tenant SLO objectives (same grammar as "
+                    "RS_SLO, e.g. 'default:encode:p99=250ms,avail=99.9'; "
+                    "GET /slo reports attainment + burn rates)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
@@ -926,8 +1103,13 @@ def main(argv=None) -> int:
         daemon = ServeDaemon(
             root, port=args.port, addr=args.addr, depth=args.depth,
             batch_ms=args.batch_ms, max_batch=args.max_batch,
-            workers=args.workers,
+            workers=args.workers, slo_spec=args.slo,
         )
+    except _slo.SLOSpecError as e:
+        print(f"rs serve: bad --slo/RS_SLO spec: {e}", file=sys.stderr)
+        if fault_ctx is not None:
+            fault_ctx.__exit__(None, None, None)
+        return 2
     except OSError as e:
         print(f"rs serve: cannot bind: {e}", file=sys.stderr)
         if fault_ctx is not None:

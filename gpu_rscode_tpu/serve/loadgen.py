@@ -43,12 +43,20 @@ _PKG_PARENT = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
 
+# Per-request rows retained for the capture (request ids + stage
+# breakdowns): bounded so a long run cannot balloon the capture file.
+_MAX_REQUEST_ROWS = 5000
+
+
 class _Recorder:
-    """Thread-safe per-(tenant, op) outcome and latency accumulator."""
+    """Thread-safe per-(tenant, op) outcome and latency accumulator,
+    plus the per-request id/stage rows the capture commits."""
 
     def __init__(self):
         self._lock = threading.Lock()
         self.cells: dict[tuple, dict] = {}
+        self.requests: list[dict] = []
+        self.request_rows_dropped = 0
 
     def _cell(self, tenant: str, op: str) -> dict:
         key = (tenant, op)
@@ -61,7 +69,8 @@ class _Recorder:
         return cell
 
     def record(self, tenant: str, op: str, status: int | None,
-               wall_s: float, nbytes: int) -> None:
+               wall_s: float, nbytes: int,
+               detail: dict | None = None) -> None:
         with self._lock:
             cell = self._cell(tenant, op)
             cell["sent"] += 1
@@ -73,6 +82,14 @@ class _Recorder:
                 cell["rejected"] += 1
             else:
                 cell["failed"] += 1
+            if len(self.requests) < _MAX_REQUEST_ROWS:
+                self.requests.append({
+                    "kind": "serve_request", "tenant": tenant, "op": op,
+                    "status": status, "wall_s": round(wall_s, 6),
+                    **(detail or {}),
+                })
+            else:
+                self.request_rows_dropped += 1
 
     def rows(self) -> list[dict]:
         from ..obs.percentile import state_quantiles
@@ -104,19 +121,61 @@ class _Recorder:
 
 
 def _post(url: str, tenant: str, body: bytes | None = None,
-          timeout: float = 120.0) -> tuple[int, bytes]:
+          timeout: float = 120.0) -> tuple[int | None, bytes, dict]:
     req = urllib.request.Request(
         url, data=body if body is not None else b"", method="POST",
         headers={"X-RS-Tenant": tenant})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
-            return resp.status, resp.read()
+            return resp.status, resp.read(), dict(resp.headers)
     except urllib.error.HTTPError as e:
         payload = e.read()
+        headers = dict(e.headers or {})
         e.close()
-        return e.code, payload
+        return e.code, payload, headers
     except (urllib.error.URLError, OSError, TimeoutError) as e:
-        return None, str(e).encode()  # transport failure — counted failed
+        # transport failure — counted failed
+        return None, str(e).encode(), {}
+
+
+def _request_detail(payload: bytes, headers: dict,
+                    json_body: bool) -> dict:
+    """Request id + stage breakdown for the capture's per-request row:
+    JSON responses carry ``req_id``/``stages_ms``/``group_id`` in the
+    body, decode streams carry ``X-RS-Request-Id``/``X-RS-Stages``
+    headers (stage offsets in seconds since admission)."""
+    out: dict = {}
+    rid = headers.get("X-RS-Request-Id")
+    if rid:
+        out["req_id"] = rid
+    if json_body:
+        try:
+            doc = json.loads(payload)
+        except ValueError:
+            return out
+        if isinstance(doc, dict):
+            out.setdefault("req_id", doc.get("req_id"))
+            if isinstance(doc.get("stages_ms"), dict):
+                out["stages"] = {s: round(v / 1e3, 6)
+                                 for s, v in doc["stages_ms"].items()}
+            upd = doc.get("update")
+            if isinstance(upd, dict) and upd.get("group_id"):
+                out["group_id"] = upd["group_id"]
+    else:
+        stages = headers.get("X-RS-Stages")
+        if stages:
+            try:
+                out["stages"] = json.loads(stages)
+            except ValueError:
+                pass
+    return out
+
+
+def _scrape_json(base_url: str, path: str) -> dict:
+    """One GET of a daemon introspection endpoint as JSON."""
+    with urllib.request.urlopen(
+            base_url.rstrip("/") + path, timeout=30) as resp:
+        return json.loads(resp.read())
 
 
 def _parse_tenants(spec: str) -> list[tuple[str, float]]:
@@ -194,11 +253,12 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
         if op == "encode":
             name = f"lg{seed}_{tenant}_{i}.bin"
             t0 = time.monotonic()
-            status, _ = _post(
+            status, payload, hdrs = _post(
                 f"{base_url}/encode?name={name}&k={k}&n={k + p}&w={w}",
                 tenant, body)
             rec.record(tenant, "encode", status,
-                       time.monotonic() - t0, size_bytes)
+                       time.monotonic() - t0, size_bytes,
+                       detail=_request_detail(payload, hdrs, True))
             if status == 200:
                 with enc_lock:
                     encoded[tenant].append(name)
@@ -210,11 +270,12 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
                 at = ((i * 7919) + j * 4099) % max(
                     1, size_bytes - delta_len + 1)
                 t0 = time.monotonic()
-                status, _ = _post(
+                status, payload, hdrs = _post(
                     f"{base_url}/update?name={name}&at={at}", tenant,
                     delta_body)
                 rec.record(tenant, "update", status,
-                           time.monotonic() - t0, delta_len)
+                           time.monotonic() - t0, delta_len,
+                           detail=_request_detail(payload, hdrs, True))
             if edit_burst <= 1:
                 one_edit(0)
             else:
@@ -229,11 +290,13 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
                     th.join(timeout=180)
         else:
             t0 = time.monotonic()
-            status, payload = _post(f"{base_url}/decode?name={name}",
-                                    tenant)
+            status, payload, hdrs = _post(f"{base_url}/decode?name={name}",
+                                          tenant)
             rec.record(tenant, "decode", status,
                        time.monotonic() - t0,
-                       len(payload) if status == 200 else 0)
+                       len(payload) if status == 200 else 0,
+                       detail=_request_detail(payload, hdrs,
+                                              status != 200))
 
     threads = []
     t_start = time.monotonic()
@@ -266,12 +329,17 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
                    "edit_burst": edit_burst, "seed": seed,
                    "tenants": dict(tenants)},
     }
+    if rec.request_rows_dropped:
+        # No silent caps: the capture must say when per-request rows
+        # were bounded away.
+        summary["request_rows_dropped"] = rec.request_rows_dropped
     if not quiet:
         print(f"loadgen: offered {summary['offered_rps']} rps -> "
               f"achieved {summary['achieved_rps']} rps "
               f"({totals['ok']} ok / {totals['rejected']} rejected / "
               f"{totals['failed']} failed)", file=sys.stderr)
-    return {"summary": summary, "tenants": rec.rows()}
+    return {"summary": summary, "tenants": rec.rows(),
+            "requests": rec.requests}
 
 
 # -- A/B: resident daemon vs CLI-subprocess-per-file --------------------------
@@ -318,7 +386,7 @@ def run_ab(*, files: int, size_bytes: int, k: int, p: int, w: int = 8,
             with open(path, "rb") as fp:
                 body = fp.read()
             t1 = time.monotonic()
-            status, _ = _post(
+            status, _, _ = _post(
                 f"{base}/encode?name=ab_{i}.bin&k={k}&n={k + p}&w={w}",
                 "ab", body)
             per_file.append(time.monotonic() - t1)
@@ -432,6 +500,13 @@ def main(argv=None) -> int:
     ap.add_argument("--faults", metavar="SPEC", default=None,
                     help="with --spawn: activate the fault plane in the "
                     "daemon for the run (bounded-error demonstration)")
+    ap.add_argument("--slo", metavar="SPEC", default=None,
+                    help="SLO objectives (RS_SLO grammar, e.g. "
+                    "'*:encode:p99=250ms,avail=99.9'): configures the "
+                    "spawned daemon, scrapes GET /slo + /debug/requests "
+                    "into the capture, and EXITS 4 when any window "
+                    "misses its objective — open-loop runs double as "
+                    "SLO gates")
     ap.add_argument("--capture", default=None,
                     help="capture JSONL path (default bench_captures/"
                     "serve_<mode>_<backend>_<ts>.jsonl; '-' disables)")
@@ -448,6 +523,18 @@ def main(argv=None) -> int:
     if not args.ab and not args.spawn and not args.url:
         print("rs loadgen: pass --url or --spawn", file=sys.stderr)
         return 2
+    if args.slo and args.ab:
+        print("rs loadgen: --slo gates open-loop runs, not --ab",
+              file=sys.stderr)
+        return 2
+    if args.slo:
+        from ..obs import slo as _slo
+
+        try:  # fail before any daemon spawns, naming the bad token
+            _slo.parse_slo(args.slo)
+        except _slo.SLOSpecError as e:
+            print(f"rs loadgen: bad --slo spec: {e}", file=sys.stderr)
+            return 2
 
     p = args.n - args.k
     rows: list[dict] = []
@@ -470,6 +557,7 @@ def main(argv=None) -> int:
 
     tmp = None
     daemon = None
+    slo_report = None
     try:
         with tempfile.TemporaryDirectory(prefix="rs_loadgen_") as tmp:
             if args.ab:
@@ -485,7 +573,7 @@ def main(argv=None) -> int:
 
                     daemon = ServeDaemon(
                         args.root or os.path.join(tmp, "serve_root"),
-                        port=0)
+                        port=0, slo_spec=args.slo)
                     daemon.start()
                     daemon.warm(args.k, p, w=args.w,
                                 file_bytes=args.size_kb * 1024)
@@ -503,7 +591,29 @@ def main(argv=None) -> int:
                     # Self-describing capture: a faulted run's error rows
                     # must not read as a regression.
                     report["summary"]["config"]["faults"] = args.faults
-                rows = [report["summary"], *report["tenants"]]
+                if args.slo:
+                    report["summary"]["config"]["slo"] = args.slo
+                rows = [report["summary"], *report["tenants"],
+                        *report["requests"]]
+                if args.slo:
+                    # Scrape the daemon's own lifecycle surfaces while it
+                    # is still alive: the SLO report (attainment + burn
+                    # rates) and its view of the recent requests — the
+                    # capture carries both sides of the id join.
+                    slo_report = _scrape_json(url, "/slo")
+                    if not slo_report.get("configured"):
+                        # A gate over zero objectives passes forever —
+                        # refuse loudly instead (an external --url
+                        # daemon must be started with --slo/RS_SLO;
+                        # --spawn configures its own).
+                        print("rs loadgen: --slo gate is vacuous: the "
+                              "daemon reports no SLO objectives "
+                              "configured (start it with rs serve "
+                              "--slo or RS_SLO)", file=sys.stderr)
+                        return 2
+                    rows.append({**slo_report, "kind": "serve_slo"})
+                    debug = _scrape_json(url, "/debug/requests?n=200")
+                    rows.append({**debug, "kind": "serve_debug_requests"})
                 if daemon is not None:
                     rows.append({"kind": "serve_daemon_stats",
                                  **daemon.stats()})
@@ -531,6 +641,23 @@ def main(argv=None) -> int:
         print(f"rs loadgen: capture -> {capture}", file=sys.stderr)
     if args.json:
         print(json.dumps({"rows": rows, "capture": capture}))
+    if args.slo:
+        # The SLO gate (docs/SERVE.md "Request lifecycle"): the run
+        # fails loudly when any rolling window missed its objective —
+        # the capture above still records everything, so a gating CI
+        # leg keeps its artifact.
+        from ..obs import slo as _slo
+
+        bad = _slo.breaches(slo_report or {})
+        if bad:
+            for b in bad:
+                print(f"rs loadgen: SLO BREACH {b['tenant']}/{b['op']} "
+                      f"{b['objective']} @{b['window']}s: attainment "
+                      f"{b['attainment']}, burn {b['burn_rate']}",
+                      file=sys.stderr)
+            return 4
+        print("rs loadgen: SLO attained across all windows",
+              file=sys.stderr)
     return 0
 
 

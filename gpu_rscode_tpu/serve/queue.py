@@ -26,7 +26,7 @@ import math
 import threading
 import time
 
-from ..obs import metrics as _metrics
+from ..obs import metrics as _metrics, reqtrace as _reqtrace
 from ..utils.env import int_env as _int_env
 
 DEFAULT_DEPTH = 64
@@ -56,6 +56,7 @@ class Request:
         "strategy", "generator", "checksums", "syndrome", "keep", "cost",
         "at", "layout", "seq", "arrival", "deadline", "batch_size",
         "queue_wait_s", "service_s", "outcome", "result", "error", "done",
+        "req_id", "batch_id", "group_id", "t_dispatch", "stages",
     )
 
     def __init__(self, op: str, tenant: str, name: str, spool: str, *,
@@ -63,7 +64,8 @@ class Request:
                  generator: str = "vandermonde", checksums: bool = True,
                  syndrome: bool = False, keep: bool = False,
                  at: int = 0, layout: str = "row",
-                 cost: int = 1, deadline: float | None = None):
+                 cost: int = 1, deadline: float | None = None,
+                 req_id: str | None = None):
         self.op = op
         self.tenant = tenant
         self.name = name
@@ -91,6 +93,15 @@ class Request:
         self.result = None
         self.error: BaseException | None = None
         self.done = threading.Event()
+        # Lifecycle identity (docs/SERVE.md "Request lifecycle"): the
+        # request id is ALWAYS minted (rejection traceability must not
+        # depend on telemetry); the stage-stamp dict is allocated only
+        # when the reqtrace plane is enabled (obs/reqtrace.py).
+        self.req_id = req_id if req_id else _reqtrace.new_request_id()
+        self.batch_id = 0         # assigned when the batcher forms a batch
+        self.group_id: str | None = None  # write-combined group join
+        self.t_dispatch = 0.0     # execution start (service_s anchor)
+        self.stages: dict | None = None
 
     def shape_key(self) -> tuple:
         """The plan-cache shape bucket this request dispatches under —
@@ -239,7 +250,9 @@ class AdmissionQueue:
             while True:
                 req = self._pop_locked()
                 if req is not None:
-                    req.queue_wait_s = time.monotonic() - req.arrival
+                    now = time.monotonic()
+                    req.queue_wait_s = now - req.arrival
+                    _reqtrace.mark(req, "dequeue", now)
                     return req
                 if self._draining:
                     return None

@@ -206,6 +206,8 @@ def apply_update_many(
     segment_bytes: int = 64 * 1024 * 1024,
     timer: PhaseTimer | None = None,
     group_edits: int | None = None,
+    group_tag: str | None = None,
+    stage_hook=None,
 ) -> dict:
     """Apply an ordered batch of edits/appends as group-committed window
     groups — byte-identical to applying them sequentially, at a fraction
@@ -213,9 +215,16 @@ def apply_update_many(
     overrides ``RS_UPDATE_GROUP_WINDOW`` for this call — the daemon's
     write combiner passes the whole batch so its harvest commits as ONE
     all-or-nothing group (its isolation fallback depends on a failed
-    batch having committed nothing).  Returns the aggregate summary dict
-    (``edits``, ``groups``, ``windows``, ``segments``,
-    ``chunks_touched``, ``total_size``, ``generation``)."""
+    batch having committed nothing).  ``group_tag`` names the commit in
+    the dispatch trace span and the returned summary (``group_id``) —
+    the daemon's write combiner passes its group id here so one combined
+    commit joins to the N request ids it acknowledges.  ``stage_hook``
+    (a ``callable(stage_name)``) fires at the lifecycle boundaries the
+    caller cannot observe from outside — currently ``"device_done"``,
+    after the last ``E·Δ`` GEMM is collected and before the journal
+    fsync chain begins (docs/SERVE.md "Request lifecycle").  Returns the
+    aggregate summary dict (``edits``, ``groups``, ``windows``,
+    ``segments``, ``chunks_touched``, ``total_size``, ``generation``)."""
     timer = timer or PhaseTimer(enabled=False)
     parsed = _parse_edits(edits)
     window = max(1, group_edits) if group_edits else group_window()
@@ -225,6 +234,7 @@ def apply_update_many(
         part = _apply_group(
             file_name, parsed[g0 : g0 + window], base=g0,
             strategy=strategy, segment_bytes=segment_bytes, timer=timer,
+            group_tag=group_tag, stage_hook=stage_hook,
         )
         groups += 1
         if summary is None:
@@ -242,11 +252,13 @@ def apply_update_many(
             summary["generation"] = part["generation"]
     assert summary is not None
     summary["groups"] = groups
+    if group_tag is not None:
+        summary["group_id"] = group_tag
     return summary
 
 
 def _apply_group(file_name, edits, *, base, strategy, segment_bytes,
-                 timer):
+                 timer, group_tag=None, stage_hook=None):
     from ..ops.gf import get_field
 
     t_start = time.perf_counter()
@@ -373,9 +385,18 @@ def _apply_group(file_name, edits, *, base, strategy, segment_bytes,
                         batch[0][2] if len(batch) == 1
                         else np.hstack([blk[2] for blk in batch])
                     )
+                    span_args = dict(
+                        op="group", off=int(batch[0][0]),
+                        cols=int(stacked.shape[1]),
+                    )
+                    if group_tag is not None:
+                        # The group <-> request-id join's trace side: a
+                        # daemon Perfetto timeline resolves this dispatch
+                        # to the write group (and through it, via the
+                        # rs_request events, to the member request ids).
+                        span_args["group"] = group_tag
                     with timer.phase("update dispatch"), _tracing.span(
-                        "dispatch", lane="dispatch", op="group",
-                        off=int(batch[0][0]), cols=int(stacked.shape[1]),
+                        "dispatch", lane="dispatch", **span_args,
                     ):
                         staged = codec.stage_segment(
                             stacked, cap=step // sym, sym=sym, out_rows=p
@@ -433,6 +454,12 @@ def _apply_group(file_name, edits, *, base, strategy, segment_bytes,
                         batch.append((b0, b1, delta, nat))
                         batch_w += b1 - b0
                 flush_batch()
+                if stage_hook is not None:
+                    # Every E·Δ GEMM is collected; everything after this
+                    # point is durability (journal sync chain, patch
+                    # drain, chunk fsyncs, metadata commit) — the
+                    # device/drain boundary of the lifecycle timeline.
+                    stage_hook("device_done")
 
                 journal_fsyncs += jr.sync()
                 _crash_point("after_journal")

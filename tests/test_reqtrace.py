@@ -1,0 +1,376 @@
+"""Request lifecycle plane (obs/reqtrace.py) + SLO engine (obs/slo.py):
+id minting/validation, the stage-timeline ring and its disabled-path
+guard, RS_SLO parsing, rolling attainment/burn math, the offline
+`rs slo` replay, and the doctor section (docs/SERVE.md "Request
+lifecycle").
+"""
+
+import json
+
+import pytest
+
+from gpu_rscode_tpu import cli
+from gpu_rscode_tpu.obs import metrics, reqtrace, runlog, slo, tracing
+from gpu_rscode_tpu.serve.queue import Request
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    monkeypatch.delenv("RS_SLO", raising=False)
+    monkeypatch.delenv("RS_SLO_WINDOWS", raising=False)
+    monkeypatch.delenv("RS_REQTRACE_RING", raising=False)
+    monkeypatch.delenv("RS_METRICS", raising=False)
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    reqtrace.reset()
+    yield
+    reqtrace.reset()
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def _req(op="encode", tenant="t", req_id=None):
+    return Request(op, tenant, "f.bin", "/tmp/f.bin", k=4, p=2, cost=1000,
+                   req_id=req_id)
+
+
+# ----- ids -------------------------------------------------------------------
+
+def test_request_id_minted_and_client_ids_validated():
+    assert reqtrace.new_request_id() != reqtrace.new_request_id()
+    assert reqtrace.accept_request_id("client-42.x") == "client-42.x"
+    # Malformed ids are REPLACED, never rejected (best-effort tracing).
+    for bad in (None, "", "a b", "x" * 65, "sp/ash", "q\n"):
+        got = reqtrace.accept_request_id(bad)
+        assert got != bad and len(got) == 16
+    # Every Request carries an id even with the plane fully disabled.
+    assert _req().req_id
+    assert _req(req_id="mine").req_id == "mine"
+
+
+# ----- disabled-path guard (tier-1) ------------------------------------------
+
+def test_disabled_plane_registers_nothing_and_allocates_only_the_id():
+    """With RS_METRICS off (and not forced) and no RS_SLO: begin() leaves
+    the stage dict unallocated, mark() no-ops, emit() returns None
+    without touching the registry or the ring — the same contract as the
+    disabled metrics/fault planes."""
+    assert not reqtrace.enabled()
+    req = _req()
+    reqtrace.begin(req)
+    assert req.stages is None  # no per-request allocation beyond the id
+    reqtrace.mark(req, "dispatch")
+    assert req.stages is None
+    assert reqtrace.emit(req, status=200) is None
+    assert reqtrace.recent(10) == []
+    assert metrics.REGISTRY.names() == []
+
+
+def test_slo_config_alone_enables_the_plane(monkeypatch):
+    monkeypatch.setenv("RS_SLO", "*:encode:p99=1s")
+    assert reqtrace.enabled()
+    req = _req()
+    reqtrace.begin(req)
+    assert req.stages is not None
+
+
+# ----- timeline + wide event -------------------------------------------------
+
+def test_stage_timeline_emit_ring_and_quantiles(tmp_path, monkeypatch):
+    metrics.force_enable()
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("RS_RUNLOG", str(ledger))
+    req = _req(op="update", req_id="rid-1")
+    reqtrace.begin(req)
+    t0 = req.arrival
+    for i, stage in enumerate(reqtrace.STAGES[1:], start=1):
+        reqtrace.mark(req, stage, t0 + i * 0.010)
+    req.batch_id = 7
+    req.group_id = "wg-abc"
+    req.t_dispatch = t0 + 0.030
+    req.service_s = 0.030
+    req.finish("ok")
+    ev = reqtrace.emit(req, status=200)
+    assert ev["req_id"] == "rid-1" and ev["outcome"] == "ok"
+    assert ev["batch_id"] == 7 and ev["group_id"] == "wg-abc"
+    offs = ev["stages"]
+    assert list(offs) == list(reqtrace.STAGES)  # canonical order
+    vals = list(offs.values())
+    assert vals == sorted(vals) and vals[0] == 0.0  # monotonic from admit
+    # Consecutive stage offsets sum to the wall by construction.
+    assert abs(ev["wall_s"] - vals[-1]) < 1e-9
+    # Ring holds it; the stage quantile family registered.
+    assert reqtrace.recent(5)[-1]["req_id"] == "rid-1"
+    assert "rs_serve_stage_seconds" in metrics.REGISTRY.names()
+    snap = metrics.REGISTRY.snapshot()["rs_serve_stage_seconds"]["values"]
+    stages_seen = {k for k in snap}
+    assert any('stage="device"' in k for k in stages_seen)
+    assert any('stage="queue_wait"' in k for k in stages_seen)
+    # The ledger got the rs_request record with the identity envelope.
+    recs = [json.loads(line) for line in open(ledger)]
+    mine = [r for r in recs if r.get("kind") == "rs_request"]
+    assert len(mine) == 1 and mine[0]["req_id"] == "rid-1"
+    assert mine[0]["run"] == runlog.run_id()
+    # ...and rs history's filter never trends it as an op measurement.
+    assert runlog.filter_records(recs, op="update") == []
+
+
+def test_emit_partial_timeline_for_rejections():
+    metrics.force_enable()
+    req = _req()
+    reqtrace.begin(req)
+    reqtrace.mark(req, "ack")
+    ev = reqtrace.emit(req, status=429)
+    assert ev["outcome"] == "rejected"
+    assert list(ev["stages"]) == ["admit", "ack"]
+
+
+def test_emit_tags_trace_spans_with_request_ids(tmp_path):
+    metrics.force_enable()
+    trace = tmp_path / "trace.json"
+    with tracing.session(str(trace)):
+        req = _req(req_id="rid-t")
+        reqtrace.begin(req)
+        t0 = req.arrival
+        reqtrace.mark(req, "dequeue", t0 + 0.001)
+        reqtrace.mark(req, "dispatch", t0 + 0.002)
+        reqtrace.mark(req, "drain_done", t0 + 0.005)
+        reqtrace.mark(req, "ack", t0 + 0.006)
+        req.finish("ok")
+        reqtrace.emit(req, status=200)
+    doc = json.load(open(trace))
+    spans = [e for e in doc["traceEvents"]
+             if e.get("ph") == "X"
+             and e.get("args", {}).get("req_id") == "rid-t"]
+    assert {e["name"] for e in spans} == {
+        "queue_wait", "dispatch_wait", "drain", "ack_write"}
+    for e in spans:
+        assert e["dur"] >= 0
+
+
+def test_ring_capacity_knob(monkeypatch):
+    metrics.force_enable()
+    monkeypatch.setenv("RS_REQTRACE_RING", "3")
+    for i in range(6):
+        req = _req(req_id=f"r{i}")
+        reqtrace.begin(req)
+        reqtrace.mark(req, "ack")
+        req.finish("ok")
+        reqtrace.emit(req, status=200)
+    got = [e["req_id"] for e in reqtrace.recent(10)]
+    assert got == ["r3", "r4", "r5"]  # bounded, newest kept
+    monkeypatch.setenv("RS_REQTRACE_RING", "0")
+    req = _req(req_id="r6")
+    reqtrace.begin(req)
+    req.finish("ok")
+    assert reqtrace.emit(req, status=200) is not None  # still emitted
+    assert reqtrace.recent(10) == []  # retained nothing
+    monkeypatch.delenv("RS_REQTRACE_RING")
+    assert reqtrace.recent(0) == [] and reqtrace.recent(-1) == []
+
+
+# ----- RS_SLO parsing --------------------------------------------------------
+
+def test_parse_slo_grammar():
+    objs = slo.parse_slo(
+        "default:encode:p99=250ms,avail=99.9;*:decode:p99=1s;"
+        "beta:*:p50=0.5s,p99=2000")
+    assert len(objs) == 3
+    enc = objs[0]
+    assert enc.tenant == "default" and enc.op == "encode"
+    assert enc.latency == {0.99: 0.25} and enc.avail == 99.9
+    assert objs[1].tenant == "*" and objs[1].latency == {0.99: 1.0}
+    assert objs[2].latency == {0.5: 0.5, 0.99: 2.0}  # bare number = ms
+    assert slo.parse_slo(None) == [] and slo.parse_slo("  ") == []
+
+
+@pytest.mark.parametrize("bad", [
+    "encode:p99=1s",               # missing tenant
+    "t:encode:p99",                # no value
+    "t:encode:p99=fast",           # bad duration
+    "t:encode:latency=1s",         # unknown key
+    "t:encode:avail=101",          # out of range
+    "t:encode:",                   # no targets
+])
+def test_parse_slo_rejects_malformed(bad):
+    with pytest.raises(slo.SLOSpecError):
+        slo.parse_slo(bad)
+
+
+def test_objective_match_specificity():
+    eng = slo.SLOEngine(
+        spec="*:*:p99=4s;*:encode:p99=3s;alpha:*:p99=2s;"
+        "alpha:encode:p99=1s")
+    assert eng.match("alpha", "encode").latency == {0.99: 1.0}
+    assert eng.match("alpha", "decode").latency == {0.99: 2.0}
+    assert eng.match("beta", "encode").latency == {0.99: 3.0}
+    assert eng.match("beta", "scrub").latency == {0.99: 4.0}
+
+
+# ----- rolling attainment + burn ---------------------------------------------
+
+def test_engine_attainment_and_burn_rates():
+    metrics.force_enable()
+    eng = slo.SLOEngine(spec="*:encode:p90=100ms,avail=90",
+                        window_lengths=(60.0,))
+    # 10 requests at t=100: 8 fast, 1 slow, 1 error.
+    for i in range(8):
+        eng.observe("t", "encode", 0.010, ok=True, t=100.0 + i * 0.1)
+    eng.observe("t", "encode", 0.500, ok=True, t=101.0)
+    eng.observe("t", "encode", 5.000, ok=False, t=102.0)
+    report = eng.report(now=110.0)
+    cell = report["cells"][0]
+    win = cell["windows"]["60"]
+    rates = win["objectives"]
+    assert win["total"] == 10 and win["served"] == 9
+    # Latency over SERVED requests only: 8/9 within 100ms vs target
+    # 0.9; burn = (1/9) / 0.1 ≈ 1.11 (the error's wall is excluded —
+    # it already burns the availability budget).
+    assert rates["p90"]["attainment"] == pytest.approx(8 / 9, abs=1e-6)
+    assert rates["p90"]["burn_rate"] == pytest.approx(1.1111, abs=1e-3)
+    assert rates["p90"]["met"] is False
+    # Availability: 9/10 ok vs target 0.9 -> exactly on budget.
+    assert rates["avail"]["attainment"] == pytest.approx(0.9)
+    assert rates["avail"]["burn_rate"] == pytest.approx(1.0)
+    assert rates["avail"]["met"] is True
+    bad = slo.breaches(report)
+    assert len(bad) == 1 and bad[0]["objective"] == "p90"
+    # Window aging: everything falls out -> empty window, no breach.
+    report = eng.report(now=1000.0)
+    assert report["cells"][0]["windows"]["60"]["total"] == 0
+    assert slo.breaches(report) == []
+
+
+def test_latency_sli_not_masked_by_fast_rejections():
+    """A window of sub-millisecond rejections plus one slow success
+    must FAIL the latency objective: rejections are excluded from the
+    latency denominator (they burn availability instead)."""
+    eng = slo.SLOEngine(spec="*:encode:p99=250ms,avail=99",
+                        window_lengths=(60.0,))
+    for i in range(99):
+        eng.observe("t", "encode", 0.001, ok=False, t=100.0 + i * 0.01)
+    eng.observe("t", "encode", 10.0, ok=True, t=101.0)
+    rates = eng.report(now=110.0)["cells"][0]["windows"]["60"]
+    assert rates["served"] == 1
+    assert rates["objectives"]["p99"]["attainment"] == 0.0
+    assert rates["objectives"]["p99"]["met"] is False
+    assert rates["objectives"]["avail"]["attainment"] == pytest.approx(
+        0.01)
+    assert {b["objective"] for b in slo.breaches(
+        eng.report(now=110.0))} == {"p99", "avail"}
+
+
+def test_latency_sli_with_zero_served_is_no_evidence_not_a_pass():
+    eng = slo.SLOEngine(spec="*:encode:p99=250ms",
+                        window_lengths=(60.0,))
+    eng.observe("t", "encode", 0.001, ok=False, t=100.0)
+    report = eng.report(now=110.0)
+    rates = report["cells"][0]["windows"]["60"]
+    assert rates["total"] == 1 and rates["served"] == 0
+    assert rates["objectives"]["p99"]["attainment"] is None
+    assert rates["objectives"]["p99"]["met"] is None
+    assert slo.breaches(report) == []  # no evidence != a breach
+    assert "no served requests" in slo.render(report)
+    metrics.force_enable()
+    eng.export_gauges(now=110.0)  # None attainment must not crash/set
+    snap = metrics.REGISTRY.snapshot()
+    assert snap.get("rs_slo_attainment", {}).get("values", {}) == {}
+
+
+def test_engine_counts_verdicts_and_ignores_unmatched():
+    metrics.force_enable()
+    eng = slo.SLOEngine(spec="alpha:encode:p99=1s")
+    eng.observe("alpha", "encode", 0.1, ok=True)
+    eng.observe("alpha", "encode", 5.0, ok=True)
+    eng.observe("alpha", "encode", 0.1, ok=False)
+    eng.observe("beta", "decode", 99.0, ok=False)  # no objective: ignored
+    snap = metrics.REGISTRY.snapshot()["rs_slo_requests_total"]["values"]
+    by_verdict = {k: v for k, v in snap.items()}
+    assert by_verdict[
+        '{op="encode",tenant="alpha",verdict="good"}'] == 1
+    assert by_verdict[
+        '{op="encode",tenant="alpha",verdict="slow"}'] == 1
+    assert by_verdict[
+        '{op="encode",tenant="alpha",verdict="error"}'] == 1
+    assert not any("beta" in k for k in by_verdict)
+    assert eng.report()["cells"][0]["tenant"] == "alpha"
+
+
+def test_export_gauges_refreshes_rolling_series():
+    metrics.force_enable()
+    eng = slo.SLOEngine(spec="*:encode:p99=1s", window_lengths=(60.0,))
+    eng.observe("t", "encode", 0.1, ok=True, t=50.0)
+    eng.export_gauges(now=60.0)
+    snap = metrics.REGISTRY.snapshot()["rs_slo_attainment"]["values"]
+    key = '{objective="p99",op="encode",tenant="t",window="60"}'
+    assert snap[key] == 1.0
+
+
+# ----- offline replay + CLI --------------------------------------------------
+
+def _write_request_records(path, walls_ok):
+    rows = []
+    for i, (wall, ok) in enumerate(walls_ok):
+        rows.append({
+            "kind": "rs_request", "req_id": f"r{i}", "tenant": "t",
+            "op": "encode", "ts": 1000.0 + i, "wall_s": wall,
+            "outcome": "ok" if ok else "error",
+        })
+    with open(path, "w") as fp:
+        for r in rows:
+            fp.write(json.dumps(r) + "\n")
+
+
+def test_rs_slo_offline_replay_and_check_gate(tmp_path, capsys):
+    ledger = tmp_path / "ledger.jsonl"
+    _write_request_records(
+        ledger, [(0.01, True)] * 98 + [(9.0, True), (0.01, False)])
+    rc = cli.main(["slo", "--runlog", str(ledger),
+                   "--slo", "*:encode:p99=100ms,avail=99", "--json"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    cell = report["cells"][0]
+    biggest = str(int(max(report["windows_s"])))
+    rates = cell["windows"][biggest]["objectives"]
+    # Latency over the 99 SERVED requests (98 fast + 1 slow); the
+    # errored one only counts against availability.
+    assert rates["p99"]["attainment"] == pytest.approx(98 / 99,
+                                                       abs=1e-6)
+    assert rates["avail"]["attainment"] == pytest.approx(0.99)
+    # --check gates: tighten the objective so the window breaches.
+    rc = cli.main(["slo", "--runlog", str(ledger),
+                   "--slo", "*:encode:p99=1ms", "--check"])
+    assert rc == 4
+    assert "BREACH" in capsys.readouterr().err
+
+
+def test_rs_slo_cli_errors(tmp_path, capsys):
+    assert cli.main(["slo"]) == 2  # no url, no ledger
+    assert "rs slo" in capsys.readouterr().err
+    ledger = tmp_path / "none.jsonl"
+    _write_request_records(ledger, [(0.01, True)])
+    assert cli.main(["slo", "--runlog", str(ledger),
+                     "--slo", "garbage"]) == 2
+    assert "bad SLO spec" in capsys.readouterr().err
+
+
+# ----- doctor section --------------------------------------------------------
+
+def test_doctor_slo_section(monkeypatch, capsys):
+    monkeypatch.setenv("RS_SLO", "default:encode:p99=250ms,avail=99.9")
+    rc = cli.main(["doctor", "--json", "--no-probe"])
+    assert rc == 0
+    report = json.loads(capsys.readouterr().out)
+    sec = report["slo"]
+    assert sec["configured"] is True
+    assert sec["objectives"][0]["tenant"] == "default"
+    assert sec["objectives"][0]["latency"] == {"p99": 0.25}
+    assert sec["windows_s"] and sec["reqtrace_ring"] >= 0
+    # Malformed spec surfaces as the parse error, never a crash.
+    monkeypatch.setenv("RS_SLO", "nope")
+    rc = cli.main(["doctor", "--json", "--no-probe"])
+    report = json.loads(capsys.readouterr().out)
+    assert report["slo"]["configured"] is False
+    assert "SLOSpecError" in report["slo"]["error"]
+    out_rc = cli.main(["doctor", "--no-probe"])
+    assert out_rc == 0
+    assert "[!!] slo:" in capsys.readouterr().out

@@ -725,6 +725,428 @@ def test_loadgen_edit_burst_schedule_and_flag():
     assert plan == again  # burst is orthogonal to the schedule
 
 
+# ----- request lifecycle plane: ids, stages, SLO (docs/SERVE.md) -------------
+
+
+def _post_h(port, path, body=b"", tenant="t1", headers=None, timeout=60):
+    """Like _post but also returns the response headers (id echo)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=body, method="POST",
+        headers={"X-RS-Tenant": tenant, **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        hdrs = dict(e.headers or {})
+        e.close()
+        return e.code, payload, hdrs
+
+
+def test_request_id_echoed_on_every_outcome_path(daemon):
+    """X-RS-Request-Id comes back on 200/400/404/504 — a rejected or
+    failed request is traceable in client logs; a client-supplied id is
+    honored, a missing/garbage one is replaced with a minted id."""
+    from gpu_rscode_tpu.obs import reqtrace
+
+    # 200 with a client id, echoed in header AND body.
+    st, body, h = _post_h(daemon.port, "/encode?name=id.bin&k=4&n=6",
+                          os.urandom(3000),
+                          headers={"X-RS-Request-Id": "cid-200"})
+    assert st == 200 and h["X-RS-Request-Id"] == "cid-200"
+    assert json.loads(body)["req_id"] == "cid-200"
+    # 200 decode: header echo (body is the file bytes).
+    st, _, h = _post_h(daemon.port, "/decode?name=id.bin",
+                       headers={"X-RS-Request-Id": "cid-dec"})
+    assert st == 200 and h["X-RS-Request-Id"] == "cid-dec"
+    # 400 (bad params) and 404 (unknown path/archive): still echoed.
+    st, _, h = _post_h(daemon.port, "/encode?name=x.bin&k=4&n=4", b"z",
+                       headers={"X-RS-Request-Id": "cid-400"})
+    assert st == 400 and h["X-RS-Request-Id"] == "cid-400"
+    st, _, h = _post_h(daemon.port, "/decode?name=ghost.bin",
+                       headers={"X-RS-Request-Id": "cid-404"})
+    assert st == 404 and h["X-RS-Request-Id"] == "cid-404"
+    st, _, h = _post_h(daemon.port, "/nope?name=x",
+                       headers={"X-RS-Request-Id": "cid-path"})
+    assert st == 404 and h["X-RS-Request-Id"] == "cid-path"
+    # 504: deadline expired before execution.
+    st, body, h = _post_h(daemon.port, "/encode?name=dl.bin&k=4&n=6",
+                          os.urandom(2000),
+                          headers={"X-RS-Request-Id": "cid-504",
+                                   "X-RS-Deadline-Ms": "0"})
+    assert st == 504 and h["X-RS-Request-Id"] == "cid-504"
+    assert json.loads(body)["req_id"] == "cid-504"
+    # Garbage client id (embedded space): replaced, never rejected.
+    st, body, h = _post_h(daemon.port, "/encode?name=g.bin&k=4&n=6",
+                          os.urandom(2000),
+                          headers={"X-RS-Request-Id": "bad id!"})
+    assert st == 200
+    got = h["X-RS-Request-Id"]
+    assert got != "bad id!" and reqtrace.accept_request_id(got) == got
+
+
+def test_request_id_echoed_on_429_and_503(tmp_path, monkeypatch):
+    from gpu_rscode_tpu.resilience import faults
+
+    monkeypatch.setenv("RS_RETRY_ATTEMPTS", "0")
+    d = ServeDaemon(str(tmp_path / "root"), port=0, depth=1, workers=1,
+                    batch_ms=0)
+    d.start()
+    plan = faults.parse_plan("read:delay@ms=150", seed=3)
+    results = []
+    lock = threading.Lock()
+
+    def client(i):
+        st, _, h = _post_h(d.port, f"/encode?name=r{i}.bin&k=4&n=6",
+                           os.urandom(4096),
+                           headers={"X-RS-Request-Id": f"cid-{i}"})
+        with lock:
+            results.append((i, st, h.get("X-RS-Request-Id")))
+
+    try:
+        with faults.activate(plan):
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+        assert any(st == 429 for _, st, _ in results), results
+        for i, st, rid in results:
+            assert rid == f"cid-{i}", (i, st, rid)  # every path echoes
+        assert d.drain(timeout=120)
+        # 503 while draining: still echoed.
+        st, _, h = _post_h(d.port, "/encode?name=late.bin&k=4&n=6",
+                           b"zz", headers={"X-RS-Request-Id": "cid-503"})
+        assert st == 503 and h["X-RS-Request-Id"] == "cid-503"
+    finally:
+        d.close(drain=False)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_stage_timeline_monotonic_and_sums_to_wall(daemon):
+    """The wide event's stage offsets are consecutive, non-overlapping
+    and sum to the request wall by construction; service_ms is the
+    dispatch->completion interval, NOT wall minus queue wait (the old
+    subtraction folded batch-form wait into service)."""
+    from gpu_rscode_tpu.obs import reqtrace
+
+    reqtrace.reset()
+    st, body, _ = _post_h(daemon.port, "/encode?name=tl.bin&k=4&n=6",
+                          os.urandom(50000),
+                          headers={"X-RS-Request-Id": "cid-tl"})
+    assert st == 200
+    doc = json.loads(body)
+    stages = doc["stages_ms"]
+    order = [s for s in reqtrace.STAGES if s in stages]
+    assert order[0] == "admit" and "dispatch" in order
+    vals = [stages[s] for s in order]
+    assert vals == sorted(vals), stages  # monotonic, non-overlapping
+    # service = dispatch -> drain_done, excluding batch wait + resp write
+    assert doc["service_ms"] == pytest.approx(
+        stages["drain_done"] - stages["dispatch"], abs=1.0)
+    # The daemon-side event carries ack and sums to the wall exactly.
+    ev = next(e for e in reqtrace.recent(50) if e["req_id"] == "cid-tl")
+    offs = [ev["stages"][s] for s in reqtrace.STAGES if s in ev["stages"]]
+    assert offs == sorted(offs)
+    assert ev["wall_s"] == pytest.approx(offs[-1])
+    deltas = [b - a for a, b in zip(offs, offs[1:])]
+    assert sum(deltas) == pytest.approx(ev["wall_s"], abs=1e-9)
+
+
+def test_write_group_joins_one_group_id_to_member_request_ids(tmp_path):
+    """Id propagation through a daemon write-combined update group: ONE
+    group id covers the combined commit, every member acks 200 under its
+    OWN client-supplied request id, and the daemon-side events carry the
+    join (docs/SERVE.md 'Request lifecycle')."""
+    from gpu_rscode_tpu.obs import reqtrace
+
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=150,
+                    workers=2)
+    d.start()
+    try:
+        reqtrace.reset()
+        rng = np.random.default_rng(41)
+        data = rng.integers(0, 256, size=200000, dtype=np.uint8).tobytes()
+        st, _, _ = _post_h(d.port, "/encode?name=j.bin&k=4&n=6", data)
+        assert st == 200
+        results = []
+        lock = threading.Lock()
+
+        def upd(j):
+            st, body, h = _post_h(
+                d.port, f"/update?name=j.bin&at={j * 9000}",
+                bytes([j + 1]) * 300,
+                headers={"X-RS-Request-Id": f"member-{j}"})
+            with lock:
+                results.append((j, st, json.loads(body), h))
+
+        threads = [threading.Thread(target=upd, args=(j,))
+                   for j in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        group_ids = set()
+        for j, st, body, h in results:
+            assert st == 200, (j, st, body)
+            assert h["X-RS-Request-Id"] == f"member-{j}"  # own id acked
+            assert body["req_id"] == f"member-{j}"
+            if body["update"].get("grouped", 1) > 1:
+                group_ids.add(body["update"]["group_id"])
+        assert len(group_ids) == 1, group_ids  # ONE combined commit
+        gid = group_ids.pop()
+        assert gid.startswith("wg-")
+        # Daemon-side events: N distinct request ids joined to the group.
+        evs = [e for e in reqtrace.recent(100) if e["group_id"] == gid]
+        assert {e["req_id"] for e in evs} >= {
+            f"member-{j}" for j, _, body, _ in results
+            if body["update"].get("grouped", 1) > 1}
+        for e in evs:
+            # The group path stamps the TRUE device/drain boundary.
+            assert "device_done" in e["stages"], e
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_fallback_isolation_rerun_keeps_original_ids(tmp_path,
+                                                     monkeypatch):
+    """A batch degraded to per-request isolation reruns each request
+    under its ORIGINAL id: fleet members after a poisoned fleet, and
+    write-group members after a poisoned edit, all ack with the ids the
+    clients sent."""
+    from gpu_rscode_tpu import api as rs_api
+
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=200,
+                    workers=2)
+    d.start()
+    try:
+        # Poison the FLEET path: encode_fleet always raises, so same-
+        # shape batches fall back to solo isolation reruns.
+        def boom(*a, **kw):
+            raise RuntimeError("poisoned fleet")
+
+        monkeypatch.setattr(rs_api, "encode_fleet", boom)
+        results = []
+        lock = threading.Lock()
+
+        def enc(i):
+            st, body, h = _post_h(
+                d.port, f"/encode?name=fb{i}.bin&k=4&n=6",
+                os.urandom(6000),
+                headers={"X-RS-Request-Id": f"fleet-{i}"})
+            with lock:
+                results.append((i, st, json.loads(body), h))
+
+        threads = [threading.Thread(target=enc, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert len(results) == 3
+        for i, st, body, h in results:
+            assert st == 200, (i, st, body)  # isolation rerun succeeded
+            assert h["X-RS-Request-Id"] == f"fleet-{i}"
+            assert body["req_id"] == f"fleet-{i}"
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_slo_endpoint_debug_requests_and_gauges(tmp_path):
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=5,
+                    slo_spec="*:encode:p99=60s,avail=50;t1:scrub:p99=60s")
+    d.start()
+    try:
+        from gpu_rscode_tpu.obs import reqtrace
+
+        reqtrace.reset()
+        for i in range(4):
+            st, _, _ = _post_h(d.port, f"/encode?name=s{i}.bin&k=4&n=6",
+                               os.urandom(3000))
+            assert st == 200
+        st, _, _ = _post_h(d.port, "/scrub?name=s0.bin")
+        assert st == 200
+        # GET /slo: attainment per (tenant, op) cell over every window.
+        st, body = _get(d.port, "/slo")
+        assert st == 200
+        report = json.loads(body)
+        assert report["configured"] is True
+        cells = {(c["tenant"], c["op"]) for c in report["cells"]}
+        assert ("t1", "encode") in cells and ("t1", "scrub") in cells
+        enc = next(c for c in report["cells"] if c["op"] == "encode")
+        for win in report["windows_s"]:
+            rates = enc["windows"][str(int(win))]
+            assert rates["total"] == 4
+            assert rates["objectives"]["p99"]["met"] is True
+            assert rates["objectives"]["avail"]["attainment"] == 1.0
+        # GET /debug/requests: the ring, newest last, n= respected.
+        st, body = _get(d.port, "/debug/requests?n=3")
+        dbg = json.loads(body)
+        assert len(dbg["requests"]) == 3
+        assert dbg["ring"] >= 3
+        for ev in dbg["requests"]:
+            assert ev["req_id"] and ev["stages"]["admit"] == 0.0
+        # /metrics carries the rs_slo_* series refreshed at scrape time.
+        st, body = _get(d.port, "/metrics")
+        text = body.decode()
+        assert "rs_slo_attainment" in text
+        assert "rs_slo_requests_total" in text
+        assert "rs_serve_stage_seconds" in text
+        # /stats reports the lifecycle config.
+        st, body = _get(d.port, "/stats")
+        stats = json.loads(body)
+        assert stats["slo"]["configured"] is True
+        assert stats["reqtrace"]["enabled"] is True
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_loadgen_slo_capture_rows_and_gate(tmp_path, capsys):
+    """`rs loadgen --slo`: capture carries per-request rows (ids +
+    stage breakdowns), the serve_slo report and the daemon's
+    /debug/requests scrape; a generous objective passes (rc 0), an
+    impossible one exits 4 — open-loop runs double as SLO gates."""
+    from gpu_rscode_tpu.obs import reqtrace
+
+    reqtrace.reset()
+    capture = str(tmp_path / "slo_cap.jsonl")
+    rc = cli.main([
+        "loadgen", "--spawn", "--duration", "2", "--rate", "8",
+        "--size-kb", "8", "--tenants", "a:1", "--seed", "11",
+        "--decode-frac", "0.2",
+        "--root", str(tmp_path / "root1"), "--capture", capture,
+        "--slo", "*:*:p99=60s,avail=50", "--json",
+    ])
+    assert rc == 0
+    out = capsys.readouterr()
+    assert "SLO attained" in out.err
+    rows = [json.loads(line) for line in open(capture)]
+    summary = next(r for r in rows if r["kind"] == "serve_summary")
+    assert summary["config"]["slo"] == "*:*:p99=60s,avail=50"
+    reqs = [r for r in rows if r["kind"] == "serve_request"]
+    assert len(reqs) == summary["sent"]
+    for r in reqs:
+        if r["status"] == 200:
+            assert r["req_id"], r
+            stages = r["stages"]
+            vals = [stages[s] for s in reqtrace.STAGES if s in stages]
+            assert vals == sorted(vals), r
+    slo_row = next(r for r in rows if r["kind"] == "serve_slo")
+    assert slo_row["configured"] and slo_row["cells"]
+    dbg_row = next(r for r in rows
+                   if r["kind"] == "serve_debug_requests")
+    assert dbg_row["requests"]
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+    # The gate: an unattainable objective exits 4 and names the breach.
+    rc = cli.main([
+        "loadgen", "--spawn", "--duration", "1", "--rate", "5",
+        "--size-kb", "8", "--tenants", "a:1", "--seed", "12",
+        "--root", str(tmp_path / "root2"),
+        "--capture", str(tmp_path / "breach.jsonl"),
+        "--slo", "*:encode:p99=0.001ms", "--json",
+    ])
+    assert rc == 4
+    assert "SLO BREACH" in capsys.readouterr().err
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def test_client_abort_does_not_burn_availability(tmp_path):
+    """status None = the client vanished mid-response: no SLO
+    observation (an impatient load generator must not fail the daemon's
+    availability objective), but the wide event records the abort
+    (acked false)."""
+    from gpu_rscode_tpu.obs import reqtrace
+
+    d = ServeDaemon(str(tmp_path / "root"), port=0,
+                    slo_spec="*:encode:p99=1s,avail=99")
+    try:
+        metrics.force_enable()  # the plane, without start()'s latch
+        reqtrace.reset()
+        req = Request("encode", "t", "x", str(tmp_path / "x"), k=4, p=2,
+                      req_id="gone")
+        reqtrace.begin(req)
+        req.t_dispatch = req.arrival
+        req.finish("ok")
+        d.finish_request(req, None)
+        assert d.slo.report()["cells"] == []  # nothing observed
+        ev = next(e for e in reqtrace.recent(10)
+                  if e["req_id"] == "gone")
+        assert ev["outcome"] == "ok" and ev["acked"] is False
+        d.finish_request(_ok_req(tmp_path), 200)
+        assert d.slo.report()["cells"], "a real ack still observes"
+    finally:
+        d.close(drain=False)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def _ok_req(tmp_path):
+    req = Request("encode", "t", "y", str(tmp_path / "y"), k=4, p=2)
+    req.finish("ok")
+    return req
+
+
+def test_doctor_reports_daemon_configured_slo(tmp_path, monkeypatch,
+                                              capsys):
+    """A daemon configured via `rs serve --slo` (no RS_SLO in the
+    operator's shell) must still surface its objectives + breach
+    summary through doctor's live probe."""
+    monkeypatch.delenv("RS_SLO", raising=False)
+    d = ServeDaemon(str(tmp_path / "root"), port=0,
+                    slo_spec="*:encode:p99=60s")
+    d.start()
+    try:
+        monkeypatch.setenv("RS_SERVE_PORT", str(d.port))
+        rc = cli.main(["doctor", "--json"])
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        sec = report["slo"]
+        assert sec["configured"] is True and sec["source"] == "daemon"
+        assert sec["objectives"][0]["op"] == "encode"
+        assert sec["attainment"] is not None
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_loadgen_url_slo_gate_refuses_unconfigured_daemon(tmp_path,
+                                                          capsys):
+    """--url + --slo against a daemon with no objectives must exit 2
+    (a gate over zero objectives would pass forever)."""
+    d = ServeDaemon(str(tmp_path / "root"), port=0)
+    d.start()
+    try:
+        rc = cli.main([
+            "loadgen", "--url", f"http://127.0.0.1:{d.port}",
+            "--duration", "0.5", "--rate", "2", "--size-kb", "4",
+            "--slo", "*:encode:p99=60s", "--capture", "-", "--json",
+        ])
+        assert rc == 2
+        assert "vacuous" in capsys.readouterr().err
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_loadgen_slo_rejects_bad_spec_and_ab(capsys):
+    assert cli.main(["loadgen", "--spawn", "--slo", "garbage"]) == 2
+    assert "bad --slo" in capsys.readouterr().err
+    assert cli.main(["loadgen", "--ab", "--slo", "*:*:p99=1s"]) == 2
+    assert "--ab" in capsys.readouterr().err
+
+
 def test_loadgen_update_schedule_mix():
     """--update-frac draws update arrivals (seeded, replayable) and the
     three op kinds partition the stream."""
