@@ -3667,6 +3667,119 @@ def recover_archive(file_name: str) -> str:
     return recover(file_name)
 
 
+# -- object-store façade (store/) ---------------------------------------------
+#
+# Many small objects share erasure-coded stripe archives instead of
+# paying per-object metadata/chunks/journal (docs/STORE.md): a durable
+# object index maps key -> (archive, byte range, CRC32), committed
+# crash-atomically alongside the archive metadata it references.  PUT
+# rides the group-commit append lane, GET decodes only the object's
+# touched column windows, DELETE tombstones + zeroes via delta-parity,
+# and compaction retires dead-heavy archives all-or-nothing.
+
+
+@_observed_file_op("object_put")
+def put_object(
+    root: str,
+    bucket: str,
+    key: str,
+    data=None,
+    *,
+    src: str | None = None,
+    create: bool = True,
+    k: int | None = None,
+    p: int | None = None,
+    w: int | None = None,
+    stripe_bytes: int | None = None,
+) -> dict:
+    """Store one object under ``key`` in ``bucket`` — ``rs object put``.
+
+    The payload comes as ``data`` bytes or a ``src`` file path.  The
+    bucket is created on first use (``create=False`` refuses instead);
+    the shape knobs apply only at creation — an existing bucket's
+    manifest wins.  Returns the location dict (``arc``, ``at``, ``len``,
+    ``crc``, ``gen``).  For PUT bursts, :func:`put_objects` commits the
+    whole batch under ONE group-committed stripe append + ONE index
+    fsync (the daemon's ``/o/`` write combining calls it)."""
+    if (data is None) == (src is None):
+        raise ValueError("pass exactly one of data= or src=")
+    if src is not None:
+        with open(src, "rb") as fp:
+            data = fp.read()
+    return put_objects(root, bucket, [(key, data)],
+                       create=create, k=k, p=p, w=w,
+                       stripe_bytes=stripe_bytes)[0]
+
+
+def put_objects(
+    root: str,
+    bucket: str,
+    items,
+    *,
+    create: bool = True,
+    k: int | None = None,
+    p: int | None = None,
+    w: int | None = None,
+    stripe_bytes: int | None = None,
+) -> list[dict]:
+    """Batch PUT: an ordered list of ``(key, bytes)`` committed as one
+    group (one journal fsync chain, one metadata rewrite, one index
+    fsync) — all-or-nothing; later duplicates win."""
+    from . import store as _store
+
+    b = _store.open_bucket(root, bucket, create=create, k=k, p=p, w=w,
+                           stripe_bytes=stripe_bytes)
+    return b.put_many(items)
+
+
+@_observed_file_op("object_get")
+def get_object(root: str, bucket: str, key: str) -> bytes:
+    """Read one object's bytes — ``rs object get``.  Reconstructs ONLY
+    the object's byte range (touched column windows; degraded decode
+    when a native chunk is damaged), verified against the object's own
+    CRC32 from the index — never silently wrong."""
+    from . import store as _store
+
+    return _store.open_bucket(root, bucket).get(key)
+
+
+@_observed_file_op("object_delete")
+def delete_object(root: str, bucket: str, key: str) -> dict:
+    """Delete one object — ``rs object rm``: durable tombstone first
+    (the commit point), then the dead range is zeroed through the
+    delta-parity patch lane; space returns at the next compaction."""
+    from . import store as _store
+
+    return _store.open_bucket(root, bucket).delete(key)
+
+
+def list_objects(root: str, bucket: str) -> list[dict]:
+    """Live objects in the bucket (tombstoned keys excluded), sorted by
+    key — ``rs object ls``."""
+    from . import store as _store
+
+    return _store.open_bucket(root, bucket).list_objects()
+
+
+def stat_object(root: str, bucket: str, key: str) -> dict:
+    """One object's index entry (archive, range, CRC, generation pin)
+    — ``rs object stat``."""
+    from . import store as _store
+
+    return _store.open_bucket(root, bucket).stat(key)
+
+
+@_observed_file_op("object_compact")
+def compact_bucket(root: str, bucket: str, *, force: bool = False) -> dict:
+    """Rewrite live objects out of dead-heavy sealed archives and
+    retire them all-or-nothing — ``rs object compact``
+    (``RS_STORE_COMPACT_DEAD_FRAC`` sets the trigger; ``force=True``
+    compacts any sealed archive with dead bytes)."""
+    from . import store as _store
+
+    return _store.open_bucket(root, bucket).compact(force=force)
+
+
 @_observed_file_op("scan")
 def scan_file(
     in_file: str,

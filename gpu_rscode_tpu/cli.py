@@ -166,6 +166,12 @@ Subcommands: rs update ARCHIVE --at OFF --in DELTA [--recover] [--json]
             windows: scrape a live daemon's GET /slo, or replay
             kind=rs_request ledger records offline; --check exits 4
             on any missed objective; docs/SERVE.md)
+            rs object put|get|rm|ls|stat|compact BUCKET [KEY] [--root D]
+            (object-store façade: millions of small objects packed into
+            shared erasure-coded stripe archives — durable object
+            index, group-committed PUT batches, range-window GET,
+            tombstone+zeroing DELETE, all-or-nothing compaction;
+            docs/STORE.md)
             RS_PROFILE=DIR wraps every file operation (scrub/fleet/chaos
             included) in a jax.profiler capture; --profile-dir is the
             per-run alias
@@ -635,6 +641,10 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.slo import main as _slo_main
 
         return _slo_main(argv[1:])
+    if argv and argv[0] == "object":
+        from .store.cli import main as _object_main
+
+        return _object_main(argv[1:])
     if argv and argv[0] in ("update", "append"):
         return _update_main(argv[1:], argv[0])
     try:

@@ -57,8 +57,8 @@ SCHEMA_VERSION = 1
 # The --json document's stable surface (pinned by tests): these keys are
 # always present, whatever the environment looks like.
 SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "update",
-            "strategies", "ledger", "metrics_endpoint", "serve", "slo",
-            "roofline")
+            "store", "strategies", "ledger", "metrics_endpoint", "serve",
+            "slo", "roofline")
 
 
 def _jax_section() -> dict:
@@ -192,6 +192,47 @@ def _update_section() -> dict:
         out["crc_fixup"] = "seekable crc32-combine (no full-chunk re-hash)"
         out["group_commit"].update(available=True, **_group_stats())
     except Exception as e:  # pragma: no cover - import-degraded env
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def _store_section(root: str | None = None) -> dict:
+    """Object-store façade health (docs/STORE.md): buckets probed
+    read-only under ``root`` (``--root`` / ``RS_STORE_ROOT``) — objects,
+    live/dead bytes, rolled-back index records pending a rewrite,
+    pending compactions — plus the knob dump.  Schema-stable: every key
+    present even with no root configured."""
+    out: dict = {
+        "root": None, "probed": False, "buckets": {},
+        "objects": 0, "live_bytes": 0, "dead_bytes": 0,
+        "pending_compactions": 0, "pending_drops": 0,
+        "knobs": {}, "error": None,
+    }
+    try:
+        from ..store import compact_dead_frac, probe, stripe_bytes_env
+
+        out["knobs"] = {
+            "RS_STORE_STRIPE_BYTES": stripe_bytes_env(),
+            "RS_STORE_COMPACT_DEAD_FRAC": compact_dead_frac(),
+            "RS_STORE_K": os.environ.get("RS_STORE_K"),
+            "RS_STORE_P": os.environ.get("RS_STORE_P"),
+        }
+        root = root or os.environ.get("RS_STORE_ROOT")
+        if not root:
+            return out
+        out["root"] = os.path.abspath(root)
+        doc = probe(root)
+        out["probed"] = True
+        out["buckets"] = doc["buckets"]
+        for b in doc["buckets"].values():
+            if "error" in b:
+                continue
+            out["objects"] += b["objects"]
+            out["live_bytes"] += b["live_bytes"]
+            out["dead_bytes"] += b["dead_bytes"]
+            out["pending_compactions"] += b["pending_compactions"]
+            out["pending_drops"] += b["pending_drops"]
+    except Exception as e:  # diagnostic must never crash
         out["error"] = f"{type(e).__name__}: {e}"
     return out
 
@@ -468,7 +509,8 @@ def _roofline_section(ledger_records: list[dict]) -> dict:
     return out
 
 
-def collect(probe_endpoint: bool = True) -> dict:
+def collect(probe_endpoint: bool = True,
+            store_root: str | None = None) -> dict:
     """The full diagnostic document (the ``--json`` payload)."""
     jax_info = _jax_section()
     ledger, ledger_records = _ledger_section()
@@ -490,6 +532,7 @@ def collect(probe_endpoint: bool = True) -> dict:
         },
         "decoder": _decoder_section(),
         "update": _update_section(),
+        "store": _store_section(store_root),
         "strategies": _strategies_section(),
         "ledger": ledger,
         "metrics_endpoint": _endpoint_section(probe_endpoint),
@@ -580,6 +623,25 @@ def render(report: dict) -> str:
             if report["update"]["delta_update"]
             else f"unavailable ({report['update']['error']})"
         ),
+        f"[{'--' if not report['store']['probed'] else mark(not report['store']['error'])}] "
+        "store: "
+        + (
+            f"{len(report['store']['buckets'])} bucket(s), "
+            f"{report['store']['objects']} objects, "
+            f"{report['store']['live_bytes']} live / "
+            f"{report['store']['dead_bytes']} dead bytes, "
+            f"{report['store']['pending_compactions']} pending "
+            f"compaction(s)"
+            + (f", {report['store']['pending_drops']} rolled-back "
+               "record(s) pending rewrite"
+               if report["store"]["pending_drops"] else "")
+            if report["store"]["probed"]
+            else (report["store"]["error"]
+                  or "no root (pass --root or set RS_STORE_ROOT)")
+        )
+        + f"; stripe {report['store']['knobs'].get('RS_STORE_STRIPE_BYTES')} B"
+          f" seal, compact @"
+          f"{report['store']['knobs'].get('RS_STORE_COMPACT_DEAD_FRAC')}",
         f"[{mark(not report['strategies']['error'])}] strategies: "
         + (
             f"{'/'.join(report['strategies']['candidates'])} compete for "
@@ -654,11 +716,15 @@ def main(argv=None) -> int:
                     help="emit the schema-stable JSON document")
     ap.add_argument("--no-probe", action="store_true",
                     help="skip the live /healthz endpoint probe")
+    ap.add_argument("--root", default=None,
+                    help="object-store root to probe for the store "
+                    "section (default $RS_STORE_ROOT; read-only)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
         return int(e.code or 0)
-    report = collect(probe_endpoint=not args.no_probe)
+    report = collect(probe_endpoint=not args.no_probe,
+                     store_root=args.root)
     if args.json:
         print(json.dumps(report))
     else:

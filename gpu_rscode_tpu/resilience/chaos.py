@@ -93,6 +93,13 @@ _PINNED_ENV = {
     # several commits, so the "torn group rolls back ALL edits" check
     # would see the earlier sub-groups legitimately committed.
     "RS_UPDATE_GROUP_WINDOW": None,
+    # The object class's schedules carry their own stripe/compaction
+    # geometry in the config; ambient store knobs would change which
+    # puts roll stripes and which archives compact — verdict drift.
+    "RS_STORE_STRIPE_BYTES": None,
+    "RS_STORE_COMPACT_DEAD_FRAC": None,
+    "RS_STORE_K": None,
+    "RS_STORE_P": None,
 }
 
 
@@ -337,6 +344,64 @@ def plan_update_group_iteration(seed: int, i: int,
     }
 
 
+def plan_object_iteration(seed: int, i: int,
+                          max_bytes: int = 49152) -> dict:
+    """The OBJECT-STORE workload class (``rs chaos --object``): seeded
+    PUT/DELETE/compact schedules against one bucket, some ops torn at a
+    random ``RS_UPDATE_CRASH`` stage, on its OWN derived seed stream
+    (``rs-chaos-object:*`` — every other class's schedules and digests
+    are untouched).
+
+    Contract checked per event and at the end (store/bucket.py): the
+    bucket's live contents stay byte-identical to a sequential mirror
+    that applies exactly the COMMITTED ops — a torn PUT batch commits
+    nothing (its index records are invalidated through the archive's
+    journal rollback: the index never references bytes a rolled-back
+    group wrote), a torn DELETE is committed (the tombstone fsyncs
+    before the zeroing patch), and a torn compaction leaves either the
+    old archive or the new locations fully live.  Every GET is
+    byte-exact or a clean 404 — never silently wrong."""
+    rng = random.Random(f"rs-chaos-object:{seed}:{i}")
+    k = rng.randint(2, 5)
+    p = rng.randint(1, 3)
+    w = 16 if rng.random() < 0.2 else 8
+    stripe_bytes = rng.choice([4096, 8192, 16384])
+    keys = [f"obj{j}" for j in range(rng.randint(3, 8))]
+    put_ever: set[str] = set()
+    events = []
+    for _ in range(rng.randint(5, 12)):
+        roll = rng.random()
+        if roll < 0.55 or not put_ever:
+            batch = [
+                {"key": rng.choice(keys),
+                 "len": rng.randint(64, min(4096, max_bytes))}
+                for _ in range(rng.randint(1, 4))
+            ]
+            ev = {"op": "put", "batch": batch}
+            put_ever.update(b["key"] for b in batch)
+        elif roll < 0.8:
+            ev = {"op": "delete", "key": rng.choice(sorted(put_ever))}
+        else:
+            ev = {"op": "compact", "force": rng.random() < 0.5}
+        if rng.random() < 0.3:
+            ev["crash"] = rng.choice(
+                ["after_journal", "mid_patch", "before_commit"]
+            )
+        events.append(ev)
+    return {
+        "seed": seed,
+        "iter": i,
+        "mode": "object",
+        "k": k,
+        "p": p,
+        "w": w,
+        "stripe_bytes": stripe_bytes,
+        "keys": keys,
+        "events": events,
+        "faults": "",
+    }
+
+
 def plan_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
     """The deterministic schedule for iteration ``i`` of master ``seed``."""
     rng = _iter_rng(seed, i)
@@ -558,6 +623,8 @@ def run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
             return _run_update_iteration(cfg, workdir, keep=keep)
         if cfg.get("mode") == "update_group":
             return _run_update_group_iteration(cfg, workdir, keep=keep)
+        if cfg.get("mode") == "object":
+            return _run_object_iteration(cfg, workdir, keep=keep)
         return _run_iteration(cfg, workdir, keep=keep)
 
 
@@ -870,6 +937,155 @@ def _run_update_group_iteration(cfg: dict, workdir: str, *,
         ],
         "final_size": len(mirror),
         "faults": cfg["faults"], "verdict": "pass",
+    }
+
+
+def _run_object_iteration(cfg: dict, workdir: str, *,
+                          keep: bool = False) -> dict:
+    """One ``object``-class iteration: run the scheduled PUT/DELETE/
+    compact sequence (torn ops included) against one bucket, holding a
+    sequential mirror of the COMMITTED ops, and prove after every event
+    that the bucket's live contents equal the mirror byte-for-byte —
+    the index must never reference bytes a rolled-back group wrote, a
+    GET is byte-exact or a clean 404, and compaction is all-or-nothing
+    (:func:`plan_object_iteration` doc)."""
+    from .. import api, store
+    from ..update import SimulatedCrash
+
+    seed, i = cfg["seed"], cfg["iter"]
+    k, p, w = cfg["k"], cfg["p"], cfg["w"]
+    base = os.path.join(workdir, f"iter{i}")
+    root = os.path.join(base, "root")
+    os.makedirs(root, exist_ok=True)
+    mirror: dict[str, bytes] = {}
+    ok = False
+
+    def check_state(bucket, what: str) -> None:
+        listed = {o["key"] for o in bucket.list_objects()}
+        _check(listed == set(mirror), cfg,
+               f"{what}: live keys {sorted(listed)} != mirror "
+               f"{sorted(mirror)}")
+        for key, want in mirror.items():
+            got = bucket.get(key)
+            _check(got == want, cfg,
+                   f"{what}: GET {key!r} returned {len(got)} bytes != "
+                   "mirror (silently wrong read)")
+
+    try:
+        store.drop_cached()
+        bucket = store.open_bucket(
+            root, "bkt", create=True, k=k, p=p, w=w,
+            stripe_bytes=cfg["stripe_bytes"],
+        )
+        for j, ev in enumerate(cfg["events"]):
+            crash = ev.get("crash")
+            payloads = {}
+            if ev["op"] == "put":
+                for e, b in enumerate(ev["batch"]):
+                    payloads[e] = random.Random(
+                        f"rs-chaos-object-data:{seed}:{i}:{j}:{e}"
+                    ).randbytes(b["len"])
+            if crash:
+                os.environ["RS_UPDATE_CRASH"] = crash
+            try:
+                committed = True
+                try:
+                    if ev["op"] == "put":
+                        bucket.put_many([
+                            (b["key"], payloads[e])
+                            for e, b in enumerate(ev["batch"])
+                        ])
+                    elif ev["op"] == "delete":
+                        try:
+                            bucket.delete(ev["key"])
+                        except store.ObjectNotFound:
+                            # Rolled-back earlier put (or double
+                            # delete): legal iff the mirror agrees.
+                            _check(ev["key"] not in mirror, cfg,
+                                   f"event {j}: delete 404 for a key "
+                                   "the mirror holds")
+                            committed = False
+                    else:
+                        bucket.compact(force=ev.get("force", False))
+                except SimulatedCrash:
+                    # Torn op: simulate process death + restart, then
+                    # prove the commit semantics.  A torn DELETE is
+                    # COMMITTED (tombstone fsyncs before the zeroing);
+                    # a torn put/compact commits nothing.
+                    store.drop_cached()
+                    bucket = store.open_bucket(root, "bkt")
+                    if ev["op"] == "delete":
+                        mirror.pop(ev["key"], None)
+                    check_state(bucket, f"event {j} (torn {ev['op']} "
+                                f"@{crash})")
+                    continue
+            finally:
+                os.environ.pop("RS_UPDATE_CRASH", None)
+            # The op completed (a scheduled crash stage may simply not
+            # exist on this path, e.g. a stripe-creating put): committed.
+            if committed and ev["op"] == "put":
+                for e, b in enumerate(ev["batch"]):
+                    mirror[b["key"]] = payloads[e]
+            elif committed and ev["op"] == "delete":
+                mirror.pop(ev["key"], None)
+            check_state(bucket, f"event {j} ({ev['op']})")
+        # Fresh-process differential: reload from disk and re-check,
+        # then prove every surviving stripe archive is healthy.
+        store.drop_cached()
+        bucket = store.open_bucket(root, "bkt")
+        check_state(bucket, "final reload")
+        bdir = os.path.join(root, "bkt")
+        for fn in sorted(os.listdir(bdir)):
+            if fn.endswith(".METADATA"):
+                report = api.scan_file(
+                    os.path.join(bdir, fn[: -len(".METADATA")]),
+                    segment_bytes=_SEGMENT_BYTES,
+                )
+                _check(
+                    report["decodable"] is True and not report["corrupt"]
+                    and not report["missing"]
+                    and not report["pending_journal"],
+                    cfg, f"stripe {fn} unhealthy after schedule: "
+                    f"{report}",
+                )
+        ok = True
+    except ChaosFailure:
+        raise
+    except Exception as e:
+        raise ChaosFailure(
+            cfg, f"unexpected {type(e).__name__}: {e}"
+        ) from e
+    finally:
+        os.environ.pop("RS_UPDATE_CRASH", None)
+        store.drop_cached()
+        verdict = "pass" if ok else "fail"
+        _metrics.counter(
+            "rs_chaos_iterations_total", "chaos-harness iteration verdicts"
+        ).labels(verdict=verdict).inc()
+        if _runlog.enabled():
+            _runlog.record({
+                "op": "chaos_iter",
+                "config": {"k": k, "n": k + p, "w": w},
+                "bytes": sum(len(v) for v in mirror.values()),
+                "chaos": {
+                    "seed": seed, "iter": i, "mode": "object",
+                    "stripe_bytes": cfg["stripe_bytes"],
+                    "events": cfg["events"], "faults": cfg["faults"],
+                },
+                "outcome": "ok" if ok else "error",
+            })
+        if ok and not keep:
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "iter": i, "mode": "object", "k": k, "p": p, "w": w,
+        "stripe_bytes": cfg["stripe_bytes"],
+        "events": [
+            ev["op"] + (":torn" if ev.get("crash") else "")
+            for ev in cfg["events"]
+        ],
+        "final_objects": len(mirror),
+        "final_bytes": sum(len(v) for v in mirror.values()),
+        "verdict": "pass",
     }
 
 
@@ -1228,6 +1444,14 @@ def main(argv: list[str] | None = None) -> int:
                     "their edits byte-exact — own seed stream, plain "
                     "--update digests unchanged (docs/UPDATE.md "
                     "\"Group commit\")")
+    ap.add_argument("--object", action="store_true",
+                    help="run the OBJECT-STORE workload class: seeded "
+                    "PUT/DELETE/compact schedules against one bucket "
+                    "with torn ops at every crash stage — the bucket's "
+                    "live contents must stay byte-identical to a "
+                    "sequential mirror of the committed ops, and the "
+                    "index must never reference rolled-back bytes — "
+                    "own seed stream (docs/STORE.md)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line per iteration")
     ap.add_argument("--keep", action="store_true",
@@ -1250,9 +1474,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"rs chaos: bad --repro JSON: {e}", file=sys.stderr)
             return 2
     else:
-        if args.silent and args.update:
-            print("rs chaos: --silent and --update conflict; pick one "
-                  "workload class", file=sys.stderr)
+        if sum((args.silent, args.update, args.object)) > 1:
+            print("rs chaos: --silent / --update / --object conflict; "
+                  "pick one workload class", file=sys.stderr)
             return 2
         if args.group and not args.update:
             print("rs chaos: --group modifies --update (the grouped "
@@ -1263,6 +1487,7 @@ def main(argv: list[str] | None = None) -> int:
             plan_update_group_iteration if args.update and args.group
             else plan_update_iteration if args.update
             else plan_silent_iteration if args.silent
+            else plan_object_iteration if args.object
             else plan_iteration
         )
         cfgs = [plan(args.seed, i, args.max_bytes) for i in indices]
@@ -1281,6 +1506,7 @@ def main(argv: list[str] | None = None) -> int:
             silent_flag = {
                 "silent": "--silent ", "update": "--update ",
                 "update_group": "--update --group ",
+                "object": "--object ",
             }.get(cfg.get("mode"), "")
             print(
                 f"rs chaos: replay the original with: rs chaos "

@@ -21,6 +21,14 @@ One long-lived process in front of the warm plan cache (docs/SERVE.md):
   They ride the same admission/DRR/deadline plane, costed by payload
   size; encode accepts ``layout=interleaved`` to create archives that
   take unbounded appends.
+* ``PUT/GET/DELETE /o/<bucket>/<key>`` + ``GET /o/<bucket>?list`` —
+  the object-store façade (docs/STORE.md): objects pack into shared
+  stripe archives under the tenant's namespace, DRR-costed by object
+  bytes.  Same-bucket PUTs harvested in one ``RS_SERVE_BATCH_MS``
+  window commit as ONE grouped stripe append + ONE index fsync (the
+  PR 13 write-combining path), GET reconstructs just the object's
+  byte range, DELETE tombstones + zeroes.  ``GET /o/<bucket>`` lists
+  (``?stats=1`` for the space-accounting report).
 * ``GET /healthz`` ``/metrics`` ``/stats`` — liveness JSON, Prometheus
   exposition of the live registry, queue/batcher introspection.
 
@@ -144,8 +152,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self) -> None:  # noqa: N802 (http.server naming)
         url = urlparse(self.path)
-        # GETs are not requests in the lifecycle sense: clear any id a
-        # previous POST on this keep-alive connection left behind.
+        if url.path.startswith("/o/"):
+            # Object reads ARE requests in the lifecycle sense: DRR
+            # cost = object bytes, queued like any other op.
+            return self._object_request("GET", url)
+        # Introspection GETs are not: clear any id a previous request
+        # on this keep-alive connection left behind.
         self._rs_req_id = None
         try:
             if url.path == "/healthz":
@@ -220,6 +232,168 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send_error_json(500, f"{type(e).__name__}: {e}")
             except Exception:
                 pass
+
+    # -- object façade (/o/<bucket>[/<key>]) ---------------------------------
+
+    def do_PUT(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        if not url.path.startswith("/o/"):
+            self._rs_req_id = _reqtrace.accept_request_id(
+                self.headers.get("X-RS-Request-Id"))
+            self._send_error_json(404, f"no such path {url.path}")
+            return
+        self._object_request("PUT", url)
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        url = urlparse(self.path)
+        if not url.path.startswith("/o/"):
+            self._rs_req_id = _reqtrace.accept_request_id(
+                self.headers.get("X-RS-Request-Id"))
+            self._send_error_json(404, f"no such path {url.path}")
+            return
+        self._object_request("DELETE", url)
+
+    def _object_request(self, method: str, url) -> None:
+        """One /o/ request end to end: mint the id, admit (or answer the
+        metadata paths inline), block on execution, respond, ack."""
+        query = parse_qs(url.query)
+        self._rs_req_id = _reqtrace.accept_request_id(
+            self.headers.get("X-RS-Request-Id"))
+        try:
+            try:
+                req = self._admit_object(method, url, query)
+            except ValueError as e:
+                self._send_error_json(400, str(e))
+                return
+            if req is None:
+                return  # answered inline (list/stat) or error sent
+            status = None
+            try:
+                status = self._respond(req)
+            finally:
+                self.daemon.finish_request(req, status)
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # defense: a handler bug must answer 500
+            try:
+                self._send_error_json(500, f"{type(e).__name__}: {e}")
+            except Exception:
+                pass
+
+    def _admit_object(self, method: str, url, query) -> Request | None:
+        from .. import store as _store
+
+        daemon = self.daemon
+        parts = [p for p in url.path.split("/") if p]  # ["o", bucket, key?]
+        if len(parts) < 2 or len(parts) > 3:
+            self._send_error_json(
+                404, f"want /o/<bucket>[/<key>], got {url.path}")
+            return None
+        tenant = _safe_name(
+            self.headers.get("X-RS-Tenant") or _q1(query, "tenant")
+            or "default", "tenant")
+        bucket = _safe_name(parts[1], "bucket")
+        key = _safe_name(parts[2], "key") if len(parts) == 3 else None
+        tenant_root = os.path.join(daemon.root, tenant)
+        spool = daemon.tenant_path(tenant, bucket)  # the bucket dir
+        deadline = None
+        dl_ms = self.headers.get("X-RS-Deadline-Ms")
+        if dl_ms is not None:
+            deadline = time.monotonic() + max(0.0, float(dl_ms)) / 1000.0
+
+        if method == "GET" and key is None:
+            # Bucket listing/report: metadata only, answered inline —
+            # it never touches the device or the stripe bytes.
+            try:
+                b = _store.open_bucket(tenant_root, bucket)
+                if _q1(query, "stats") == "1":
+                    self._send_json(200, {"ok": True,
+                                          "stats": b.stats()})
+                else:
+                    self._send_json(200, {"ok": True, "bucket": bucket,
+                                          "objects": b.list_objects()})
+            except _store.ObjectNotFound as e:
+                self._send_error_json(404, str(e))
+            except (_store.ObjectStoreError, OSError, ValueError) as e:
+                self._send_error_json(400, f"{type(e).__name__}: {e}")
+            return None
+        if key is None:
+            self._send_error_json(
+                404, f"{method} needs /o/<bucket>/<key>")
+            return None
+
+        if method == "PUT":
+            for knob in ("k", "n", "w", "stripe_kb"):
+                val = _q1(query, knob)
+                if val is not None and not val.isdigit():
+                    self._send_error_json(
+                        400, f"{knob}= must be an integer, got {val!r}")
+                    return None
+            # Bucket-shape params validate at admission like /encode's:
+            # a bad shape must 400 here, not 500 in the executor (or
+            # silently create a default-shaped bucket from half a pair).
+            k = int(_q1(query, "k", "0"))
+            n = int(_q1(query, "n", "0"))
+            if (k > 0) != (n > 0):
+                self._send_error_json(
+                    400, "pass k= and n= together (bucket shape at "
+                    f"creation), got k={k or None} n={n or None}")
+                return None
+            if n and not n > k > 0:
+                self._send_error_json(
+                    400, f"need n > k > 0, got k={k} n={n}")
+                return None
+            w_q = int(_q1(query, "w", "0") or 0)
+            if w_q not in (0, 8, 16):
+                self._send_error_json(
+                    400, f"w must be 8 or 16, got {w_q}")
+                return None
+            upload = f"{spool}.up.{daemon.next_upload_id()}"
+            os.makedirs(os.path.dirname(upload), exist_ok=True)
+            nbytes = self._read_body_to(upload)
+            if nbytes == 0:
+                os.unlink(upload)
+                self._send_error_json(
+                    400, "refusing an empty object body")
+                return None
+            req = Request(
+                "object_put", tenant, bucket, spool, key=key,
+                k=k, p=max(0, n - k), w=w_q,
+                stripe_bytes=(int(_q1(query, "stripe_kb", "0")) * 1024
+                              or None),
+                cost=nbytes, deadline=deadline,
+                req_id=self._rs_req_id,
+            )
+            req.upload = upload
+        else:  # GET / DELETE of one object: cost = the object's bytes
+            try:
+                stat = _store.open_bucket(tenant_root, bucket).stat(key)
+            except _store.ObjectNotFound as e:
+                self._send_error_json(404, str(e))
+                return None
+            except (_store.ObjectStoreError, OSError, ValueError) as e:
+                self._send_error_json(400, f"{type(e).__name__}: {e}")
+                return None
+            req = Request(
+                "object_get" if method == "GET" else "object_delete",
+                tenant, bucket, spool, key=key, cost=stat["bytes"],
+                deadline=deadline, req_id=self._rs_req_id,
+            )
+
+        _reqtrace.begin(req)
+        try:
+            daemon.queue.submit(req)
+        except QueueFull as e:
+            daemon.discard_upload(req)
+            self._send_error_json(429, str(e), {"Retry-After": "1"})
+            daemon.finish_request(req, 429)
+            return None
+        except Draining as e:
+            daemon.discard_upload(req)
+            self._send_error_json(503, str(e), {"Retry-After": "5"})
+            daemon.finish_request(req, 503)
+            return None
+        return req
 
     def _read_body_to(self, spool: str) -> int:
         """Stream the request body to the spool file; returns byte count."""
@@ -393,6 +567,13 @@ class _Handler(BaseHTTPRequestHandler):
                 **base, "error": "deadline exceeded before execution"})
             return 504
         elif req.outcome != "ok":
+            from ..store import ObjectNotFound
+
+            if isinstance(req.error, ObjectNotFound):
+                # Raced by a DELETE between admission and execution:
+                # a clean 404, not a daemon error.
+                self._send_json(404, {**base, "error": str(req.error)})
+                return 404
             self._send_json(500, {
                 **base,
                 "error": str(req.error),
@@ -400,6 +581,17 @@ class _Handler(BaseHTTPRequestHandler):
                 if req.error else None,
             })
             return 500
+        elif req.op == "object_get":
+            data: bytes = req.result
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(data)))
+            self.send_header("X-RS-Request-Id", req.req_id)
+            if stages is not None:
+                self.send_header("X-RS-Stages", json.dumps(stages))
+            self.end_headers()
+            self.wfile.write(data)
+            return 200
         elif req.op == "decode":
             out_path = req.result
             try:
@@ -436,6 +628,9 @@ class _Handler(BaseHTTPRequestHandler):
                     os.path.basename(f) for f in (req.result or [])]
             elif req.op in ("update", "append"):
                 payload["update"] = req.result  # the engine's op summary
+            elif req.op in ("object_put", "object_delete"):
+                payload["object"] = req.result  # location / tombstone
+                payload["key"] = req.key
             else:  # scrub
                 payload["report"] = req.result
             self._send_json(200, payload)
@@ -692,6 +887,12 @@ class ServeDaemon:
                 "window_ms": self.batcher.batch_ms,
                 **_group_stats(),
             },
+            # Object-store façade health (docs/STORE.md): per-tenant
+            # bucket accounting — objects, live/dead bytes, pending
+            # compactions.  Open buckets report their live in-memory
+            # view (O(archives), no log replay per scrape); buckets
+            # this daemon never opened get the read-only disk probe.
+            "store": self._store_block(),
             # Lifecycle plane config (docs/SERVE.md "Request lifecycle").
             "slo": {
                 "configured": bool(self.slo.objectives),
@@ -701,6 +902,54 @@ class ServeDaemon:
             "reqtrace": {
                 "enabled": _reqtrace.enabled(),
                 "ring": _reqtrace.ring_capacity(),
+            },
+        }
+
+    def _store_block(self) -> dict:
+        from .. import store as _store
+
+        tenants = {}
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            names = []
+        for t in names:
+            tdir = os.path.join(self.root, t)
+            if not os.path.isdir(tdir):
+                continue
+            buckets = {}
+            cold = []  # buckets this daemon never opened
+            for name in _store.list_buckets(tdir):
+                b = _store.cached_bucket(tdir, name)
+                if b is None:
+                    cold.append(name)
+                    continue
+                # Live in-memory view — no on-disk log replay per
+                # scrape (a monitoring poller must stay O(archives)).
+                s = b.stats()
+                buckets[name] = {
+                    "objects": s["objects"],
+                    "archives": len(s["archives"]),
+                    "live_bytes": s["live_bytes"],
+                    "dead_bytes": s["dead_bytes"],
+                    "index_records": s["index_records"],
+                    "pending_drops": 0,  # resolved at load by contract
+                    "pending_journals": 0,
+                    "pending_compactions": s["pending_compactions"],
+                    "config": s["config"],
+                }
+            if cold:
+                probed = _store.probe(tdir)["buckets"]
+                for name in cold:
+                    if name in probed:
+                        buckets[name] = probed[name]
+            if buckets:
+                tenants[t] = buckets
+        return {
+            "tenants": tenants,
+            "knobs": {
+                "RS_STORE_STRIPE_BYTES": _store.stripe_bytes_env(),
+                "RS_STORE_COMPACT_DEAD_FRAC": _store.compact_dead_frac(),
             },
         }
 
@@ -809,6 +1058,18 @@ class ServeDaemon:
                 # stamped; the stage dict only when the plane is on).
                 req.t_dispatch = t_disp
                 _reqtrace.mark(req, "dispatch", t_disp)
+            if len(live) > 1 and live[0].op == "object_put":
+                # Object write combining (docs/STORE.md): the shape key
+                # pins these to one (tenant, bucket), so the window's
+                # harvest commits as ONE grouped stripe append + ONE
+                # index fsync — a PUT burst costs one journal fsync
+                # chain and one stacked E·Δ GEMM.
+                if self._run_object_put_group(live):
+                    return
+                _metrics.counter(
+                    "rs_serve_batch_fallbacks_total",
+                    "batches degraded to per-request execution",
+                ).inc()
             if len(live) > 1 and live[0].op in ("update", "append"):
                 # Write combining (docs/UPDATE.md "Group commit"): the
                 # shape key pins these to one (tenant, archive), so the
@@ -929,6 +1190,51 @@ class ServeDaemon:
                                  "group_id": group_id})
         return True
 
+    def _object_bucket(self, req: Request):
+        from .. import store as _store
+
+        return _store.open_bucket(
+            os.path.join(self.root, req.tenant), req.name,
+            create=req.op == "object_put",
+            k=req.k or None, p=req.p or None, w=req.w or None,
+            stripe_bytes=req.stripe_bytes,
+        )
+
+    @staticmethod
+    def _object_payload(req: Request) -> bytes:
+        with open(req.upload, "rb") as fp:
+            return fp.read()
+
+    def _run_object_put_group(self, live: list[Request]) -> bool:
+        """One put_many batch for a same-bucket PUT harvest (submission
+        order; later duplicate keys win, like sequential PUTs).
+        All-or-nothing by construction — put_many commits nothing on
+        failure — so the isolation fallback (return False) can always
+        rerun members solo without double-applies."""
+        from ..update.engine import SimulatedCrash
+
+        ordered = sorted(live, key=lambda r: r.seq)
+        group_id = f"og-{_reqtrace.new_request_id()}"
+        for r in ordered:
+            r.group_id = group_id
+        try:
+            with self._name_lock((ordered[0].tenant, ordered[0].name)):
+                bucket = self._object_bucket(ordered[0])
+                items = [(r.key, self._object_payload(r))
+                         for r in ordered]
+                locations = bucket.put_many(items)
+        except SimulatedCrash:
+            raise  # chaos-only: not a fallback case, the disk is torn
+        except Exception:
+            for r in ordered:
+                r.group_id = None
+            return False
+        for r, loc in zip(ordered, locations):
+            self.discard_upload(r)
+            self._finish(r, "ok", result={
+                **loc, "grouped": len(ordered), "group_id": group_id})
+        return True
+
     def _run_fleet(self, live: list[Request]) -> bool:
         """One warm-executable fleet for a same-shape batch; False when it
         failed and the caller should fall back to solo isolation."""
@@ -999,6 +1305,22 @@ class ServeDaemon:
                         req.spool, self._decode_out(req),
                         strategy=req.strategy, timer=timer,
                     )
+                    self._mark_device_done(req, timer)
+                    self._finish(req, "ok", result=out)
+                elif req.op == "object_put":
+                    bucket = self._object_bucket(req)
+                    loc = bucket.put(req.key, self._object_payload(req))
+                    self._mark_device_done(req, timer)
+                    self.discard_upload(req)
+                    self._finish(req, "ok", result=loc)
+                elif req.op == "object_get":
+                    bucket = self._object_bucket(req)
+                    data = bucket.get(req.key)
+                    self._mark_device_done(req, timer)
+                    self._finish(req, "ok", result=data)
+                elif req.op == "object_delete":
+                    bucket = self._object_bucket(req)
+                    out = bucket.delete(req.key)
                     self._mark_device_done(req, timer)
                     self._finish(req, "ok", result=out)
                 elif req.op in ("update", "append"):

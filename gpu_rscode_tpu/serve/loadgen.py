@@ -121,9 +121,10 @@ class _Recorder:
 
 
 def _post(url: str, tenant: str, body: bytes | None = None,
-          timeout: float = 120.0) -> tuple[int | None, bytes, dict]:
+          timeout: float = 120.0, method: str = "POST",
+          ) -> tuple[int | None, bytes, dict]:
     req = urllib.request.Request(
-        url, data=body if body is not None else b"", method="POST",
+        url, data=body if body is not None else b"", method=method,
         headers={"X-RS-Tenant": tenant})
     try:
         with urllib.request.urlopen(req, timeout=timeout) as resp:
@@ -161,6 +162,10 @@ def _request_detail(payload: bytes, headers: dict,
             upd = doc.get("update")
             if isinstance(upd, dict) and upd.get("group_id"):
                 out["group_id"] = upd["group_id"]
+            obj = doc.get("object")
+            if isinstance(obj, dict) and obj.get("group_id"):
+                # Object PUT write-combining join (og-* ids).
+                out["group_id"] = obj["group_id"]
     else:
         stages = headers.get("X-RS-Stages")
         if stages:
@@ -190,12 +195,15 @@ def _parse_tenants(spec: str) -> list[tuple[str, float]]:
 
 
 def _schedule(duration_s: float, rate: float, tenants, decode_frac: float,
-              seed: int, update_frac: float = 0.0) -> list:
+              seed: int, update_frac: float = 0.0,
+              object_frac: float = 0.0) -> list:
     """The full open-loop arrival plan, drawn up front (seeded — the same
     offered load replays exactly).  ``update_frac`` mixes in partial-
     stripe writes (``POST /update`` of a small random range of an
-    archive the tenant already encoded) — the mixed read/write tenant
-    traffic of the object-store/journal workload class."""
+    archive the tenant already encoded); ``object_frac`` mixes in
+    object-façade traffic (``PUT``/``GET /o/<bucket>/<key>`` against a
+    zipf-hot key space — docs/STORE.md) — the millions-of-small-objects
+    workload class."""
     rng = random.Random(seed)
     names = [t for t, _ in tenants]
     weights = [w for _, w in tenants]
@@ -207,19 +215,30 @@ def _schedule(duration_s: float, rate: float, tenants, decode_frac: float,
             return plan
         tenant = rng.choices(names, weights)[0]
         roll = rng.random()
-        if roll < decode_frac:
+        if roll < object_frac:
+            op = "object"
+        elif roll < object_frac + decode_frac:
             op = "decode"
-        elif roll < decode_frac + update_frac:
+        elif roll < object_frac + decode_frac + update_frac:
             op = "update"
         else:
             op = "encode"
         plan.append((t, tenant, op))
 
 
+def _zipf_weights(keys: int, s: float) -> list[float]:
+    """Unnormalized zipf(s) rank weights over ``keys`` keys — the
+    classic hot-key object workload (a few keys take most traffic)."""
+    return [1.0 / (r + 1) ** s for r in range(keys)]
+
+
 def run_open_loop(base_url: str, *, duration_s: float, rate: float,
                   tenants: list[tuple[str, float]], size_bytes: int,
                   k: int, p: int, w: int = 8, decode_frac: float = 0.3,
                   update_frac: float = 0.0, edit_burst: int = 1,
+                  object_frac: float = 0.0, object_bytes: int = 4096,
+                  object_keys: int = 256, object_zipf: float = 1.1,
+                  object_burst: int = 1,
                   seed: int = 0, quiet: bool = False) -> dict:
     """Drive the daemon at ``base_url``; returns the summary document.
 
@@ -230,7 +249,7 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
     one group-committed batch and the per-request p50/p99 shows the
     amortized durability chain."""
     plan = _schedule(duration_s, rate, tenants, decode_frac, seed,
-                     update_frac)
+                     update_frac, object_frac)
     rec = _Recorder()
     # One shared payload buffer per size (arrival threads must not spend
     # their schedule slot generating bytes); per-request uniqueness comes
@@ -243,7 +262,63 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
     delta_len = max(1, min(4096, size_bytes))
     delta_body = random.Random(seed ^ 0xDE17A).randbytes(delta_len)
 
+    # Object workload state: a zipf-hot key space per tenant; a key's
+    # first arrival PUTs it, later arrivals GET (mostly) or re-PUT.
+    # Payload is keyed so a GET's bytes are verifiable regardless of
+    # how many re-PUTs raced: rows record status only.
+    obj_weights = _zipf_weights(object_keys, object_zipf)
+    obj_put: dict[tuple, bool] = {}
+    obj_lock = threading.Lock()
+    obj_body = random.Random(seed ^ 0x0B1EC7).randbytes(
+        max(1, object_bytes))
+
     def fire(i: int, tenant: str, op: str) -> None:
+        if op == "object":
+            # Deterministic per-arrival key draw from the zipf weights.
+            krng = random.Random((seed << 20) ^ i)
+            kidx = krng.choices(range(object_keys), obj_weights)[0]
+            key = f"k{kidx:05d}"
+            with obj_lock:
+                seen = obj_put.get((tenant, key), False)
+            do_put = (not seen) or krng.random() < 0.3
+            t0 = time.monotonic()
+            if do_put:
+                def one_put(j: int, pkey: str) -> None:
+                    t1 = time.monotonic()
+                    status, payload, hdrs = _post(
+                        f"{base_url}/o/lg{seed}/{pkey}", tenant,
+                        obj_body, method="PUT")
+                    rec.record(tenant, "object_put", status,
+                               time.monotonic() - t1, len(obj_body),
+                               detail=_request_detail(payload, hdrs,
+                                                      True))
+                    if status == 200:
+                        with obj_lock:
+                            obj_put[(tenant, pkey)] = True
+                if object_burst <= 1:
+                    one_put(0, key)
+                else:
+                    # The salvo lands in one daemon harvest window, so
+                    # the bucket's write combining commits it as ONE
+                    # grouped stripe append (docs/STORE.md).
+                    burst = [threading.Thread(
+                        target=one_put,
+                        args=(j, f"k{(kidx + j) % object_keys:05d}"),
+                        daemon=True) for j in range(object_burst)]
+                    for th in burst:
+                        th.start()
+                    for th in burst:
+                        th.join(timeout=180)
+            else:
+                status, payload, hdrs = _post(
+                    f"{base_url}/o/lg{seed}/{key}", tenant, None,
+                    method="GET")
+                rec.record(tenant, "object_get", status,
+                           time.monotonic() - t0,
+                           len(payload) if status == 200 else 0,
+                           detail=_request_detail(payload, hdrs,
+                                                  status != 200))
+            return
         if op in ("decode", "update"):
             with enc_lock:
                 pool = encoded[tenant]
@@ -326,7 +401,12 @@ def run_open_loop(base_url: str, *, duration_s: float, rate: float,
                    "size_bytes": size_bytes, "rate": rate,
                    "decode_frac": decode_frac,
                    "update_frac": update_frac,
-                   "edit_burst": edit_burst, "seed": seed,
+                   "edit_burst": edit_burst,
+                   "object_frac": object_frac,
+                   "object_bytes": object_bytes,
+                   "object_keys": object_keys,
+                   "object_zipf": object_zipf,
+                   "object_burst": object_burst, "seed": seed,
                    "tenants": dict(tenants)},
     }
     if rec.request_rows_dropped:
@@ -447,6 +527,132 @@ def _ab_row(arm: str, files: int, size_bytes: int, wall: float,
     }
 
 
+# -- A/B: object façade vs one-archive-per-object ------------------------------
+
+def run_object_ab(*, files: int, object_bytes: int, k: int, p: int,
+                  w: int = 8, batch: int = 64, trials: int = 3,
+                  workdir: str, quiet: bool = False) -> list[dict]:
+    """The façade's raison d'être, measured: store ``files`` small
+    objects once through one bucket (PUT batches of ``batch`` — the
+    write-combining unit a daemon harvest forms) and once as one
+    archive per object (today's model: per-object metadata, k+p chunk
+    files, its own commit).  Paired best-of-``trials`` per arm (the
+    repo's A/B idiom — fs/scheduler noise at 4 KiB op sizes swings
+    single runs ±40%), EVERY object of EVERY trial byte-verified
+    outside the timed regions; the per-archive arm's file-count
+    amplification is recorded alongside the walls."""
+    from .. import api
+    from .. import store as _store
+
+    rng = random.Random(20260804)
+    payloads = [rng.randbytes(max(1, object_bytes)) for _ in range(files)]
+
+    # Warm the plan cache OUTSIDE both timed regions (a resident
+    # process pays its compiles once; the A/B measures steady state):
+    # one per-archive-shaped encode for arm B, one same-batch-shaped
+    # put_many for arm A (stripe-create encode + grouped-append E·Δ
+    # both hit their real plan buckets).
+    warmdir = os.path.join(workdir, "warm")
+    os.makedirs(warmdir, exist_ok=True)
+    wseed = os.path.join(warmdir, "warm.bin")
+    with open(wseed, "wb") as fp:
+        fp.write(rng.randbytes(max(1, object_bytes)))
+    api.encode_file(wseed, k, p, w=w, checksums=True,
+                    layout="interleaved")
+    wb = _store.open_bucket(
+        warmdir, "warmbkt", create=True, k=k, p=p, w=w,
+        stripe_bytes=max(1 << 20, 16 * object_bytes * batch))
+    wpay = [(f"w{j}", rng.randbytes(max(1, object_bytes)))
+            for j in range(batch)]
+    wb.put_many(wpay)  # stripe create (encode lane)
+    wb.put_many(wpay)  # grouped append (E*delta lane)
+
+    walls_a, walls_b = [], []
+    files_a = files_b = 0
+    for trial in range(max(1, trials)):
+        # Arm A — the façade: batched PUTs into shared stripes.
+        root = os.path.join(workdir, f"store_root_{trial}")
+        t0 = time.monotonic()
+        bucket = _store.open_bucket(
+            root, "ab", create=True, k=k, p=p, w=w,
+            stripe_bytes=max(1 << 20, 16 * object_bytes * batch))
+        for lo in range(0, files, batch):
+            bucket.put_many([
+                (f"o{i:06d}", payloads[i])
+                for i in range(lo, min(lo + batch, files))
+            ])
+        walls_a.append(time.monotonic() - t0)
+        for i in range(files):  # byte-verify OUTSIDE the timed region
+            if bucket.get(f"o{i:06d}") != payloads[i]:
+                raise RuntimeError(
+                    f"facade arm verification failed at {i}")
+        files_a = sum(len(fs) for _, _, fs in os.walk(root))
+
+        # Arm B — one archive per object.
+        perdir = os.path.join(workdir, f"per_archive_{trial}")
+        os.makedirs(perdir, exist_ok=True)
+        t0 = time.monotonic()
+        for i in range(files):
+            path = os.path.join(perdir, f"o{i:06d}.bin")
+            with open(path, "wb") as fp:
+                fp.write(payloads[i])
+            api.encode_file(path, k, p, w=w, checksums=True,
+                            layout="interleaved")
+            os.unlink(path)  # the archive stores it now, like arm A
+        walls_b.append(time.monotonic() - t0)
+        files_b = sum(len(fs) for _, _, fs in os.walk(perdir))
+        for i in range(files):  # byte-verify EVERY archive, like arm A
+            probe = os.path.join(perdir, f"o{i:06d}.bin")
+            out = api.auto_decode_file(probe, probe + ".dec")
+            ok = open(out, "rb").read() == payloads[i]
+            os.unlink(out)
+            if not ok:
+                raise RuntimeError(
+                    f"per-archive arm verification failed at {i}")
+
+    wall_a, wall_b = min(walls_a), min(walls_b)
+    rows = [
+        {
+            "kind": "object_ab", "arm": "facade", "files": files,
+            "object_bytes": object_bytes, "batch": batch,
+            "wall_s": round(wall_a, 4),
+            "trial_walls_s": [round(wl, 4) for wl in walls_a],
+            "objects_per_s": round(files / wall_a, 2) if wall_a
+            else None,
+            "disk_files": files_a, "verified": True,
+            "config": {"k": k, "n": k + p, "w": w},
+        },
+        {
+            "kind": "object_ab", "arm": "per_archive", "files": files,
+            "object_bytes": object_bytes,
+            "wall_s": round(wall_b, 4),
+            "trial_walls_s": [round(wl, 4) for wl in walls_b],
+            "objects_per_s": round(files / wall_b, 2) if wall_b
+            else None,
+            "disk_files": files_b, "verified": True,
+            "config": {"k": k, "n": k + p, "w": w},
+        },
+    ]
+    margin = wall_b / wall_a if wall_a else None
+    rows.append({
+        "kind": "object_ab_margin", "files": files,
+        "object_bytes": object_bytes, "batch": batch,
+        "trials": max(1, trials),
+        "facade_wall_s": round(wall_a, 4),
+        "per_archive_wall_s": round(wall_b, 4),
+        "speedup": round(margin, 2) if margin else None,
+        "disk_files_facade": files_a,
+        "disk_files_per_archive": files_b,
+    })
+    if not quiet:
+        print(f"loadgen object A/B: facade {wall_a:.2f}s vs "
+              f"per-archive {wall_b:.2f}s over {files} x "
+              f"{object_bytes} B (best of {max(1, trials)}) -> "
+              f"{margin:.1f}x ({files_a} vs {files_b} files on disk)",
+              file=sys.stderr)
+    return rows
+
+
 # -- CLI ----------------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -492,11 +698,37 @@ def main(argv=None) -> int:
     ap.add_argument("--w", type=int, default=8, choices=(8, 16))
     ap.add_argument("--seed", type=int, default=0,
                     help="arrival-process seed (default 0)")
+    ap.add_argument("--object-frac", type=float, default=0.0,
+                    help="fraction of arrivals hitting the object "
+                    "facade (PUT/GET /o/<bucket>/<key>, zipf-hot keys; "
+                    "docs/STORE.md; default 0)")
+    ap.add_argument("--object-bytes", type=int, default=4096,
+                    help="object payload size (default 4096)")
+    ap.add_argument("--object-keys", type=int, default=256,
+                    help="object key-space size (default 256)")
+    ap.add_argument("--object-zipf", type=float, default=1.1,
+                    help="zipf skew of the key draw (default 1.1)")
+    ap.add_argument("--object-burst", type=int, default=1,
+                    help="object PUTs fired CONCURRENTLY per object-put "
+                    "arrival (distinct keys, same bucket) — the salvo "
+                    "lands in one batch window so the daemon commits it "
+                    "as ONE grouped stripe append (default 1)")
     ap.add_argument("--ab", action="store_true",
                     help="A/B mode instead of open-loop: resident daemon "
                     "vs CLI subprocess per file on --files encodes")
+    ap.add_argument("--object-ab", action="store_true",
+                    help="A/B mode: --files small objects through the "
+                    "store facade (PUT batches of --object-batch) vs "
+                    "one archive per object — the per-object metadata "
+                    "amortization margin (docs/STORE.md)")
+    ap.add_argument("--object-batch", type=int, default=64,
+                    help="--object-ab facade PUT batch size (default 64 "
+                    "— the write-combining unit)")
+    ap.add_argument("--object-trials", type=int, default=3,
+                    help="--object-ab paired trials per arm, best wall "
+                    "wins (default 3)")
     ap.add_argument("--files", type=int, default=100,
-                    help="--ab file count (default 100)")
+                    help="--ab / --object-ab item count (default 100)")
     ap.add_argument("--faults", metavar="SPEC", default=None,
                     help="with --spawn: activate the fault plane in the "
                     "daemon for the run (bounded-error demonstration)")
@@ -520,12 +752,17 @@ def main(argv=None) -> int:
         print(f"rs loadgen: need n > k > 0 (got k={args.k} n={args.n})",
               file=sys.stderr)
         return 2
-    if not args.ab and not args.spawn and not args.url:
+    if args.ab and args.object_ab:
+        print("rs loadgen: --ab and --object-ab conflict; pick one",
+              file=sys.stderr)
+        return 2
+    if not args.ab and not args.object_ab and not args.spawn \
+            and not args.url:
         print("rs loadgen: pass --url or --spawn", file=sys.stderr)
         return 2
-    if args.slo and args.ab:
-        print("rs loadgen: --slo gates open-loop runs, not --ab",
-              file=sys.stderr)
+    if args.slo and (args.ab or args.object_ab):
+        print("rs loadgen: --slo gates open-loop runs, not --ab/"
+              "--object-ab", file=sys.stderr)
         return 2
     if args.slo:
         from ..obs import slo as _slo
@@ -566,6 +803,14 @@ def main(argv=None) -> int:
                     k=args.k, p=p, w=args.w, workdir=tmp,
                     quiet=args.json)
                 mode = "ab"
+            elif args.object_ab:
+                rows = run_object_ab(
+                    files=args.files, object_bytes=args.object_bytes,
+                    k=args.k, p=p, w=args.w,
+                    batch=max(1, args.object_batch),
+                    trials=max(1, args.object_trials), workdir=tmp,
+                    quiet=args.json)
+                mode = "object_ab"
             else:
                 url = args.url
                 if args.spawn:
@@ -586,6 +831,11 @@ def main(argv=None) -> int:
                     w=args.w, decode_frac=args.decode_frac,
                     update_frac=args.update_frac,
                     edit_burst=max(1, args.edit_burst),
+                    object_frac=args.object_frac,
+                    object_bytes=args.object_bytes,
+                    object_keys=max(1, args.object_keys),
+                    object_zipf=args.object_zipf,
+                    object_burst=max(1, args.object_burst),
                     seed=args.seed, quiet=args.json)
                 if args.faults:
                     # Self-describing capture: a faulted run's error rows
@@ -617,7 +867,9 @@ def main(argv=None) -> int:
                 if daemon is not None:
                     rows.append({"kind": "serve_daemon_stats",
                                  **daemon.stats()})
-                mode = "faulted" if args.faults else "openloop"
+                mode = ("faulted" if args.faults
+                        else "object" if args.object_frac > 0
+                        else "openloop")
     finally:
         if daemon is not None:
             daemon.close(drain=True, timeout=120)

@@ -54,7 +54,8 @@ class Request:
     __slots__ = (
         "op", "tenant", "name", "spool", "upload", "k", "p", "w",
         "strategy", "generator", "checksums", "syndrome", "keep", "cost",
-        "at", "layout", "seq", "arrival", "deadline", "batch_size",
+        "at", "layout", "key", "stripe_bytes", "seq", "arrival",
+        "deadline", "batch_size",
         "queue_wait_s", "service_s", "outcome", "result", "error", "done",
         "req_id", "batch_id", "group_id", "t_dispatch", "stages",
     )
@@ -64,6 +65,7 @@ class Request:
                  generator: str = "vandermonde", checksums: bool = True,
                  syndrome: bool = False, keep: bool = False,
                  at: int = 0, layout: str = "row",
+                 key: str | None = None, stripe_bytes: int | None = None,
                  cost: int = 1, deadline: float | None = None,
                  req_id: str | None = None):
         self.op = op
@@ -82,6 +84,8 @@ class Request:
         self.keep = keep
         self.at = int(at)         # update: byte offset of the edit
         self.layout = layout      # encode: chunk layout (docs/UPDATE.md)
+        self.key = key            # object ops: the object key (/o/ paths)
+        self.stripe_bytes = stripe_bytes  # object_put bucket creation
         self.cost = max(1, int(cost))
         self.seq = 0  # assigned at submit (admission order)
         self.arrival = time.monotonic()
@@ -111,10 +115,19 @@ class Request:
         in the same window execute as one group-committed batch (one
         journal fsync chain + one metadata commit — docs/UPDATE.md
         "Group commit"), and mixing updates with appends in that group is
-        exactly what the group engine's sequential semantics handle."""
+        exactly what the group engine's sequential semantics handle.
+        Object PUTs key by (tenant, bucket) the same way: a same-bucket
+        PUT burst harvested in one window commits as ONE grouped stripe
+        append + ONE index fsync (store/bucket.py put_many)."""
         if self.op in ("update", "append"):
             return ("write", self.tenant, self.name, self.k, self.p,
                     self.w, self.strategy)
+        if self.op == "object_put":
+            return ("objput", self.tenant, self.name)
+        if self.op in ("object_get", "object_delete"):
+            # Reads/deletes serialize under the bucket lock anyway;
+            # grouping buys nothing — keep them solo batches.
+            return (self.op, self.tenant, self.name, self.seq)
         return (self.op, self.k, self.p, self.w, self.strategy,
                 self.generator, self.layout)
 

@@ -1,0 +1,29 @@
+"""Object-store façade: millions of small objects in shared stripes.
+
+The packing layer over the archive model (docs/STORE.md): a **bucket**
+maps many small objects into a handful of shared erasure-coded stripe
+archives — one durable object index (key -> archive, byte range, CRC,
+generation pin) instead of per-object metadata/chunks/journals.  PUT
+appends into the open stripe through the group-commit lane, GET
+reconstructs just the object's byte range (touched column windows
+only), DELETE is a tombstone plus delete-as-update zeroing, and
+compaction retires dead-heavy archives all-or-nothing.
+
+Surfaces: ``api.put_object``/``get_object``/... wrappers, the daemon's
+``/o/<bucket>/<key>`` endpoints (write-combined PUT bursts), and the
+``rs object`` CLI (store/cli.py).
+"""
+
+from .bucket import (  # noqa: F401
+    Bucket,
+    ObjectNotFound,
+    ObjectStoreError,
+    cached_bucket,
+    compact_dead_frac,
+    drop_cached,
+    list_buckets,
+    open_bucket,
+    probe,
+    stripe_bytes_env,
+)
+from .readpath import RangeReadError, read_range  # noqa: F401

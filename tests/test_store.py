@@ -1,0 +1,681 @@
+"""Object-store façade tests (gpu_rscode_tpu/store/, docs/STORE.md):
+index durability, tombstone semantics, windowed range reads vs full
+decode, generation-mismatch recovery, compaction all-or-nothing, the
+daemon /o/ endpoints (write combining included), the rs object CLI,
+and the doctor/probe surfaces."""
+
+import json
+import os
+import random
+import threading
+import urllib.error
+import urllib.request
+import zlib
+
+import pytest
+
+from gpu_rscode_tpu import api, store
+from gpu_rscode_tpu.store import index as store_index
+from gpu_rscode_tpu.update.engine import SimulatedCrash
+from gpu_rscode_tpu.utils.fileformat import (
+    chunk_file_name,
+    metadata_file_name,
+    read_archive_meta,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_buckets():
+    store.drop_cached()
+    yield
+    store.drop_cached()
+
+
+def _bucket(tmp_path, **kw):
+    kw.setdefault("k", 3)
+    kw.setdefault("p", 2)
+    kw.setdefault("stripe_bytes", 64 * 1024)
+    return store.open_bucket(str(tmp_path), "bkt", create=True, **kw)
+
+
+def _reload(tmp_path):
+    store.drop_cached()
+    return store.open_bucket(str(tmp_path), "bkt")
+
+
+# -- basic semantics ----------------------------------------------------------
+
+def test_put_get_roundtrip_and_overwrite(tmp_path):
+    b = _bucket(tmp_path)
+    b.put("a", b"A" * 5000)
+    b.put("b", b"B" * 100)
+    assert b.get("a") == b"A" * 5000
+    assert b.get("b") == b"B" * 100
+    b.put("a", b"X" * 321)  # later writer wins
+    assert b.get("a") == b"X" * 321
+
+
+def test_put_many_single_group_commit(tmp_path):
+    from gpu_rscode_tpu.update import group_stats
+
+    b = _bucket(tmp_path)
+    b.put("seed", b"s" * 64)  # stripe exists: the batch APPENDS
+    before = group_stats()
+    locs = b.put_many([(f"k{i}", bytes([i]) * 500) for i in range(8)])
+    after = group_stats()
+    # One grouped commit for the whole batch: one group, 8 edits, one
+    # journal fsync, one metadata commit.
+    assert after["groups"] - before["groups"] == 1
+    assert after["edits"] - before["edits"] == 8
+    assert after["journal_fsyncs"] - before["journal_fsyncs"] == 1
+    assert after["metadata_commits"] - before["metadata_commits"] == 1
+    # Offsets pack back-to-back in batch order.
+    assert [l2["at"] - l1["at"] for l1, l2 in zip(locs, locs[1:])] \
+        == [500] * 7
+    for i in range(8):
+        assert b.get(f"k{i}") == bytes([i]) * 500
+
+
+def test_put_batch_duplicate_keys_later_wins(tmp_path):
+    b = _bucket(tmp_path)
+    b.put_many([("k", b"first"), ("k", b"second")])
+    assert b.get("k") == b"second"
+
+
+def test_empty_payload_and_bad_keys_rejected(tmp_path):
+    b = _bucket(tmp_path)
+    with pytest.raises(store.ObjectStoreError):
+        b.put("k", b"")
+    with pytest.raises(store.ObjectStoreError):
+        b.put("", b"x")
+    with pytest.raises(store.ObjectStoreError):
+        b.put("bad\nkey", b"x")
+
+
+def test_tombstone_semantics(tmp_path):
+    b = _bucket(tmp_path)
+    b.put("alive", b"a" * 256)
+    b.put("doomed", b"d" * 256)
+    out = b.delete("doomed")
+    assert out["bytes"] == 256
+    with pytest.raises(store.ObjectNotFound):
+        b.get("doomed")
+    with pytest.raises(store.ObjectNotFound):
+        b.delete("doomed")  # double delete is a clean 404
+    assert [o["key"] for o in b.list_objects()] == ["alive"]
+    # ... and all of it survives a process restart.
+    b2 = _reload(tmp_path)
+    with pytest.raises(store.ObjectNotFound):
+        b2.get("doomed")
+    assert [o["key"] for o in b2.list_objects()] == ["alive"]
+    assert b2.get("alive") == b"a" * 256
+
+
+def test_delete_zeroes_the_dead_range(tmp_path):
+    b = _bucket(tmp_path)
+    b.put("pad", b"p" * 64)
+    loc = b.put("z", b"\xaa" * 600)
+    b.delete("z")
+    # The dead range reads back as zeros through the raw range reader
+    # (delete-as-update pushed zeros through the delta-parity lane).
+    got = store.read_range(
+        os.path.join(str(tmp_path), "bkt", loc["arc"]),
+        loc["at"], loc["len"])
+    assert got == b"\x00" * 600
+
+
+def test_index_roundtrip_across_restart(tmp_path):
+    b = _bucket(tmp_path)
+    blobs = {f"o{i}": os.urandom(random.Random(i).randint(1, 3000))
+             for i in range(10)}
+    for k, v in sorted(blobs.items()):
+        b.put(k, v)
+    stats = b.stats()
+    b2 = _reload(tmp_path)
+    for k, v in blobs.items():
+        assert b2.get(k) == v
+    assert b2.stats()["objects"] == stats["objects"] == 10
+
+
+def test_stat_and_stats_schema(tmp_path):
+    b = _bucket(tmp_path)
+    b.put("k", b"v" * 123)
+    st = b.stat("k")
+    assert st["bytes"] == 123 and st["arc"].startswith("stripe-")
+    assert set(st) >= {"key", "at", "crc32", "pinned_generation",
+                       "archive_generation"}
+    doc = b.stats()
+    assert set(doc) >= {"bucket", "objects", "live_bytes", "dead_bytes",
+                        "index_records", "archives",
+                        "pending_compactions", "config"}
+    arc = doc["archives"][st["arc"]]
+    assert set(arc) >= {"total_bytes", "live_bytes", "dead_bytes",
+                        "generation", "sealed", "compaction_candidate"}
+
+
+# -- range-read correctness ---------------------------------------------------
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_range_read_equals_full_decode(tmp_path, w):
+    """Byte-equality of the windowed read path against the whole-archive
+    decode, for ranges spanning chunk seams and the ragged tail — on the
+    stripe layout the façade uses."""
+    data = bytes(random.Random(42).randbytes(10240 + 7))
+    src = str(tmp_path / "file.bin")
+    with open(src, "wb") as fp:
+        fp.write(data)
+    api.encode_file(src, 3, 2, w=w, checksums=True, layout="interleaved",
+                    segment_bytes=4096)
+    out = api.auto_decode_file(src, src + ".dec", segment_bytes=4096)
+    full = open(out, "rb").read()
+    assert full == data
+    total = len(data)
+    probes = [(0, 1), (0, total), (total - 1, 1), (total - 513, 513),
+              (2000, 4096), (4095, 2), (1, total - 2), (5000, 0)]
+    for at, ln in probes:
+        assert store.read_range(src, at, ln) == data[at:at + ln], \
+            (at, ln)
+    # CRC-verified variant (the GET path).
+    assert store.read_range(
+        src, 2000, 4096, crc=zlib.crc32(data[2000:6096])
+    ) == data[2000:6096]
+
+
+def test_range_read_row_layout(tmp_path):
+    data = bytes(random.Random(7).randbytes(9000))
+    src = str(tmp_path / "row.bin")
+    with open(src, "wb") as fp:
+        fp.write(data)
+    api.encode_file(src, 3, 2, checksums=True, segment_bytes=2048)
+    chunk = read_archive_meta(metadata_file_name(src)).chunk
+    probes = [(0, 100), (chunk - 5, 10), (chunk * 2 - 1, 2),
+              (chunk - 1, chunk + 2), (0, 9000), (8999, 1)]
+    for at, ln in probes:
+        assert store.read_range(src, at, ln) == data[at:at + ln], \
+            (at, ln)
+    # Degraded: drop one touched native chunk — windowed reconstruction
+    # from the survivors, byte-identical.
+    os.unlink(chunk_file_name(src, 0))
+    for at, ln in probes:
+        assert store.read_range(src, at, ln) == data[at:at + ln], \
+            (at, ln)
+
+
+def test_range_read_bounds_and_unrecoverable(tmp_path):
+    data = b"r" * 4096
+    src = str(tmp_path / "b.bin")
+    with open(src, "wb") as fp:
+        fp.write(data)
+    api.encode_file(src, 3, 2, checksums=True, layout="interleaved")
+    with pytest.raises(store.RangeReadError):
+        store.read_range(src, 4000, 200)  # past EOF
+    with pytest.raises(store.RangeReadError):
+        store.read_range(src, -1, 10)
+    # Damage beyond parity: p+1 = 3 chunks gone -> loud error, never
+    # fabricated bytes.
+    for i in range(3):
+        os.unlink(chunk_file_name(src, i))
+    with pytest.raises(store.RangeReadError):
+        store.read_range(src, 0, 100)
+
+
+def test_get_degraded_after_native_chunk_loss(tmp_path):
+    b = _bucket(tmp_path)
+    blobs = {f"o{i}": bytes(random.Random(i).randbytes(2048))
+             for i in range(6)}
+    for k, v in sorted(blobs.items()):
+        b.put(k, v)
+    arc = b.stat("o3")["arc"]
+    os.unlink(os.path.join(str(tmp_path), "bkt",
+                           chunk_file_name(arc, 1)))
+    for k, v in blobs.items():
+        assert b.get(k) == v  # windowed degraded decode per object
+
+
+def test_get_detects_silent_bitrot_via_object_crc(tmp_path):
+    b = _bucket(tmp_path)
+    b.put("x", b"\x55" * 2048)
+    loc = b.stat("x")
+    arcbase = os.path.join(str(tmp_path), "bkt", loc["arc"])
+    # Flip a byte of the object's range in native chunk 0 — full-chunk
+    # size checks can't see it; the OBJECT CRC must, and the degraded
+    # pass must repair the read from parity.
+    path = chunk_file_name(arcbase, 0)
+    with open(path, "r+b") as fp:
+        fp.seek(10)
+        byte = fp.read(1)
+        fp.seek(10)
+        fp.write(bytes([byte[0] ^ 0xFF]))
+    assert b.get("x") == b"\x55" * 2048
+
+
+# -- crash atomicity ----------------------------------------------------------
+
+@pytest.mark.parametrize("stage",
+                         ["after_journal", "mid_patch", "before_commit"])
+def test_torn_put_batch_commits_nothing(tmp_path, monkeypatch, stage):
+    b = _bucket(tmp_path)
+    b.put("old", b"o" * 512)
+    gen0 = read_archive_meta(metadata_file_name(os.path.join(
+        str(tmp_path), "bkt", b.stat("old")["arc"]))).generation
+    monkeypatch.setenv("RS_UPDATE_CRASH", stage)
+    with pytest.raises(SimulatedCrash):
+        b.put_many([("new1", b"n" * 256), ("old", b"CHANGED" * 64)])
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    b2 = _reload(tmp_path)
+    # The index never references bytes the rolled-back group wrote.
+    with pytest.raises(store.ObjectNotFound):
+        b2.get("new1")
+    assert b2.get("old") == b"o" * 512
+    arc = b2.stat("old")["arc"]
+    meta = read_archive_meta(metadata_file_name(
+        os.path.join(str(tmp_path), "bkt", arc)))
+    assert meta.generation == gen0  # rolled back, not advanced
+
+
+def test_rolled_back_records_cannot_resurrect(tmp_path, monkeypatch):
+    """The pin-validation hole the load-time rewrite closes: a torn
+    put's records are scrubbed from the log at recovery, so a LATER
+    commit that advances the generation to the pinned value cannot
+    revive them."""
+    b = _bucket(tmp_path)
+    b.put("seed", b"s" * 128)
+    monkeypatch.setenv("RS_UPDATE_CRASH", "before_commit")
+    with pytest.raises(SimulatedCrash):
+        b.put("ghost", b"g" * 256)
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    b2 = _reload(tmp_path)  # recovery drops + rewrites the log
+    b2.put("fresh", b"f" * 256)  # advances generation past the pin
+    b3 = _reload(tmp_path)
+    with pytest.raises(store.ObjectNotFound):
+        b3.get("ghost")
+    assert b3.get("fresh") == b"f" * 256
+    raw = open(b3.index_file).read()
+    assert "ghost" not in raw
+
+
+def test_inprocess_put_failure_scrubs_prewritten_records(
+        tmp_path, monkeypatch):
+    """A non-crash failure mid-batch rolls the archive back in-process;
+    the pre-written index records must be scrubbed immediately (no
+    reopen in between), or a later commit reaching their pinned
+    generation would resurrect them."""
+    b = _bucket(tmp_path)
+    b.put("seed", b"s" * 128)
+
+    def failing(*a, **kw):
+        raise RuntimeError("injected engine failure")
+
+    monkeypatch.setattr(api, "update_file_many", failing)
+    with pytest.raises(RuntimeError):
+        b.put_many([("k1", b"x" * 100), ("k2", b"y" * 100)])
+    monkeypatch.undo()
+    # Same process, no reopen: the records must already be gone.
+    with pytest.raises(store.ObjectNotFound):
+        b.get("k1")
+    b.put("after", b"z" * 100)  # advances the generation past the pin
+    b2 = _reload(tmp_path)
+    with pytest.raises(store.ObjectNotFound):
+        b2.get("k1")
+    assert b2.get("after") == b"z" * 100
+
+
+def test_torn_delete_is_committed(tmp_path, monkeypatch):
+    b = _bucket(tmp_path)
+    b.put("pad", b"p" * 64)
+    b.put("d", b"d" * 512)
+    monkeypatch.setenv("RS_UPDATE_CRASH", "mid_patch")
+    with pytest.raises(SimulatedCrash):
+        b.delete("d")  # tombstone fsyncs BEFORE the zeroing patch
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    b2 = _reload(tmp_path)
+    with pytest.raises(store.ObjectNotFound):
+        b2.get("d")
+    assert b2.get("pad") == b"p" * 64
+
+
+# -- stripe roll / compaction -------------------------------------------------
+
+def test_stripe_rolls_at_seal_threshold(tmp_path):
+    b = _bucket(tmp_path, stripe_bytes=8 * 1024)
+    for i in range(6):
+        b.put(f"k{i}", bytes([i]) * 3000)
+    st = b.stats()
+    assert len(st["archives"]) >= 2  # rolled at least once
+    sealed = [a for a, v in st["archives"].items() if v["sealed"]]
+    assert sealed
+    for i in range(6):
+        assert b.get(f"k{i}") == bytes([i]) * 3000
+
+
+def test_compaction_reclaims_and_preserves(tmp_path):
+    b = _bucket(tmp_path, stripe_bytes=8 * 1024)
+    for i in range(6):
+        b.put(f"k{i}", bytes([i]) * 3000)
+    for i in range(4):
+        b.delete(f"k{i}")
+    st = b.stats()
+    assert st["pending_compactions"] >= 1
+    res = b.compact()
+    assert res["archives_retired"]
+    for arc in res["archives_retired"]:
+        bdir = os.path.join(str(tmp_path), "bkt")
+        assert not os.path.exists(os.path.join(
+            bdir, metadata_file_name(arc)))
+        assert not os.path.exists(os.path.join(
+            bdir, chunk_file_name(arc, 0)))
+    assert b.get("k4") == bytes([4]) * 3000
+    assert b.get("k5") == bytes([5]) * 3000
+    b2 = _reload(tmp_path)
+    assert b2.get("k4") == bytes([4]) * 3000
+    assert {o["key"] for o in b2.list_objects()} == {"k4", "k5"}
+
+
+@pytest.mark.parametrize("stage",
+                         ["after_journal", "mid_patch", "before_commit"])
+def test_torn_compaction_all_or_nothing(tmp_path, monkeypatch, stage):
+    b = _bucket(tmp_path, stripe_bytes=8 * 1024)
+    # stripe1 seals with k0..k2, stripe2 with k3..k5, k6 opens stripe3
+    # (the compaction target — its grouped APPEND is the crash surface).
+    for i in range(7):
+        b.put(f"k{i}", bytes([i]) * 3000)
+    for i in (0, 1):  # stripe1: 2/3 dead, live survivor k2
+        b.delete(f"k{i}")
+    survivors = {k: b.get(k) for k in ("k2", "k3", "k4", "k5", "k6")}
+    monkeypatch.setenv("RS_UPDATE_CRASH", stage)
+    with pytest.raises(SimulatedCrash):
+        b.compact()
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    b2 = _reload(tmp_path)
+    # Old archive fully live OR new locations fully live — and every
+    # object byte-identical either way.
+    for k, v in survivors.items():
+        assert b2.get(k) == v
+    assert {o["key"] for o in b2.list_objects()} == set(survivors)
+    res = b2.compact()  # the redo completes the retirement
+    assert res["archives_retired"]
+    for k, v in survivors.items():
+        assert b2.get(k) == v
+    b3 = _reload(tmp_path)
+    for k, v in survivors.items():
+        assert b3.get(k) == v
+
+
+def test_compact_force_and_noop(tmp_path):
+    b = _bucket(tmp_path, stripe_bytes=4 * 1024)
+    b.put("a", b"a" * 3000)
+    b.put("b", b"b" * 3000)  # seals stripe 1... (roll on next put)
+    b.put("c", b"c" * 3000)
+    res = b.compact()  # nothing dead -> noop
+    assert res["archives_retired"] == []
+    b.delete("a")
+    res = b.compact(force=True)
+    assert res["archives_retired"]
+    assert b.get("b") == b"b" * 3000
+    assert b.get("c") == b"c" * 3000
+
+
+# -- index internals ----------------------------------------------------------
+
+def test_index_torn_tail_healed(tmp_path):
+    path = str(tmp_path / "idx")
+    store_index.append_records(path, [
+        {"t": "put", "key": "a", "arc": "stripe-00000001", "at": 0,
+         "len": 4, "crc": 1, "gen": 0},
+    ])
+    with open(path, "a") as fp:
+        fp.write('{"t": "put", "key": "torn", "arc"')  # torn tail
+    recs = store_index.read_records(path)
+    assert [r["key"] for r in recs] == ["a"]
+
+
+def test_index_replay_generation_pin_and_missing(tmp_path):
+    recs = [
+        {"t": "put", "key": "ok", "arc": "s1", "at": 0, "len": 4,
+         "crc": 1, "gen": 2},
+        {"t": "put", "key": "ok", "arc": "s1", "at": 8, "len": 4,
+         "crc": 2, "gen": 5},  # rolled back: gen 5 > live gen 3
+        {"t": "put", "key": "gone", "arc": "s9", "at": 0, "len": 4,
+         "crc": 3, "gen": 0},  # archive missing
+        {"t": "del", "key": "dead", "gen": 1},
+    ]
+    st = store_index.replay(recs, {"s1": 3})
+    # The EARLIER valid record wins over the rolled-back overwrite.
+    assert st.entries["ok"]["at"] == 0 and st.entries["ok"]["gen"] == 2
+    assert "gone" not in st.entries
+    assert st.dirty
+    assert st.dropped_rolled_back == 1 and st.dropped_missing == 1
+
+
+def test_api_wrappers_roundtrip(tmp_path):
+    root = str(tmp_path)
+    loc = api.put_object(root, "b", "k", b"v" * 99, k=3, p=2)
+    assert loc["len"] == 99
+    assert api.get_object(root, "b", "k") == b"v" * 99
+    assert api.stat_object(root, "b", "k")["bytes"] == 99
+    assert [o["key"] for o in api.list_objects(root, "b")] == ["k"]
+    out = api.delete_object(root, "b", "k")
+    assert out["bytes"] == 99
+    assert api.list_objects(root, "b") == []
+    assert api.compact_bucket(root, "b", force=True) is not None
+
+
+def test_probe_is_readonly_and_counts_pending_drops(tmp_path,
+                                                    monkeypatch):
+    b = _bucket(tmp_path)
+    b.put("seed", b"s" * 128)
+    monkeypatch.setenv("RS_UPDATE_CRASH", "before_commit")
+    with pytest.raises(SimulatedCrash):
+        b.put("ghost", b"g" * 128)
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    idx = b.index_file
+    raw_before = open(idx, "rb").read()
+    doc = store.probe(str(tmp_path))
+    # Read-only: the torn archive keeps its journal, the log its bytes.
+    assert open(idx, "rb").read() == raw_before
+    info = doc["buckets"]["bkt"]
+    assert info["pending_journals"] == 1
+    assert info["objects"] >= 1
+    assert set(doc["knobs"]) == {"RS_STORE_STRIPE_BYTES",
+                                 "RS_STORE_COMPACT_DEAD_FRAC"}
+
+
+def test_doctor_store_section(tmp_path, monkeypatch):
+    from gpu_rscode_tpu.obs import doctor
+
+    b = _bucket(tmp_path)
+    b.put("k", b"v" * 100)
+    report = doctor.collect(probe_endpoint=False,
+                            store_root=str(tmp_path))
+    assert set(doctor.SECTIONS) <= set(report)
+    sec = report["store"]
+    assert sec["probed"] and sec["objects"] == 1
+    assert "bkt" in sec["buckets"]
+    assert "RS_STORE_STRIPE_BYTES" in sec["knobs"]
+    assert "store:" in doctor.render(report)
+    # Without a root: schema-stable, probed False.
+    monkeypatch.delenv("RS_STORE_ROOT", raising=False)
+    sec = doctor.collect(probe_endpoint=False)["store"]
+    assert sec["probed"] is False and sec["buckets"] == {}
+
+
+# -- daemon /o/ endpoints -----------------------------------------------------
+
+@pytest.fixture()
+def daemon(tmp_path):
+    from gpu_rscode_tpu.serve.daemon import ServeDaemon
+
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=20)
+    d.start()
+    yield d
+    d.close(drain=True, timeout=60)
+
+
+def _call(d, method, path, body=None, tenant="t"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{d.port}{path}", data=body, method=method,
+        headers={"X-RS-Tenant": tenant})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read(), dict(resp.headers)
+    except urllib.error.HTTPError as e:
+        payload = e.read()
+        headers = dict(e.headers or {})
+        e.close()
+        return e.code, payload, headers
+
+
+def test_daemon_object_roundtrip(daemon):
+    s, body, hdrs = _call(daemon, "PUT", "/o/bkt/hello?k=3&n=5",
+                          b"hi" * 500)
+    assert s == 200
+    doc = json.loads(body)
+    assert doc["ok"] and doc["key"] == "hello"
+    assert doc["object"]["len"] == 1000
+    assert hdrs.get("X-RS-Request-Id")
+    s, body, hdrs = _call(daemon, "GET", "/o/bkt/hello")
+    assert s == 200 and body == b"hi" * 500
+    assert hdrs.get("X-RS-Request-Id")
+    s, body, _ = _call(daemon, "GET", "/o/bkt?list")
+    assert s == 200
+    assert [o["key"] for o in json.loads(body)["objects"]] == ["hello"]
+    s, body, _ = _call(daemon, "GET", "/o/bkt?stats=1")
+    assert json.loads(body)["stats"]["objects"] == 1
+    s, body, _ = _call(daemon, "DELETE", "/o/bkt/hello")
+    assert s == 200 and json.loads(body)["object"]["bytes"] == 1000
+    s, _, _ = _call(daemon, "GET", "/o/bkt/hello")
+    assert s == 404
+
+
+def test_daemon_object_errors(daemon):
+    s, _, _ = _call(daemon, "PUT", "/o/bkt/empty", b"")
+    assert s == 400
+    s, _, _ = _call(daemon, "GET", "/o/nosuch/k")
+    assert s == 404
+    s, _, _ = _call(daemon, "DELETE", "/o/bkt/nokey", None)
+    assert s == 404  # bucket missing too -> 404 either way
+    s, _, _ = _call(daemon, "PUT", "/o/bkt/../evil", b"x")
+    assert s in (400, 404)
+    s, _, _ = _call(daemon, "PUT", "/o/bkt/k?k=abc", b"x")
+    assert s == 400
+    s, _, _ = _call(daemon, "POST", "/o/bkt/k")
+    assert s == 404  # /o/ is PUT/GET/DELETE, not POST
+
+
+def test_daemon_put_burst_write_combines(daemon):
+    from gpu_rscode_tpu.update import group_stats
+
+    # Seed the bucket so the burst APPENDS (journal-grouped path).
+    s, _, _ = _call(daemon, "PUT", "/o/bkt/seed?k=3&n=5", b"s" * 100)
+    assert s == 200
+    before = group_stats()
+    results = {}
+
+    def put(i):
+        results[i] = _call(daemon, "PUT", f"/o/bkt/obj{i}",
+                           bytes([i]) * 800)
+
+    threads = [threading.Thread(target=put, args=(i,))
+               for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    after = group_stats()
+    codes = [results[i][0] for i in range(6)]
+    assert codes == [200] * 6
+    docs = [json.loads(results[i][1]) for i in range(6)]
+    grouped = [d["object"].get("grouped") for d in docs]
+    group_ids = {d["object"].get("group_id") for d in docs
+                 if d["object"].get("group_id")}
+    # The salvo write-combined: grouped journal fsyncs << request count.
+    groups_delta = after["groups"] - before["groups"]
+    fsync_delta = after["journal_fsyncs"] - before["journal_fsyncs"]
+    assert groups_delta < 6 and fsync_delta < 6
+    if any(g and g > 1 for g in grouped):
+        assert len(group_ids) >= 1  # members share an og-* group id
+    for i in range(6):
+        s, body, _ = _call(daemon, "GET", f"/o/bkt/obj{i}")
+        assert s == 200 and body == bytes([i]) * 800
+    # /stats carries the store block.
+    s, body, _ = _call(daemon, "GET", "/stats")
+    st = json.loads(body)
+    assert "t" in st["store"]["tenants"]
+    assert "bkt" in st["store"]["tenants"]["t"]
+
+
+def test_daemon_object_tenant_isolation(daemon):
+    s, _, _ = _call(daemon, "PUT", "/o/bkt/k", b"alpha", tenant="alpha")
+    assert s == 200
+    s, _, _ = _call(daemon, "GET", "/o/bkt/k", tenant="beta")
+    assert s == 404  # beta's namespace has no such bucket
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_object_cli_roundtrip(tmp_path, capsys):
+    from gpu_rscode_tpu.store.cli import main as object_main
+
+    root = str(tmp_path / "root")
+    payload = tmp_path / "p.bin"
+    payload.write_bytes(b"cli" * 300)
+    assert object_main(["put", "bkt", "k1", "--in", str(payload),
+                        "--root", root, "--k", "3", "--p", "2"]) == 0
+    out = tmp_path / "out.bin"
+    assert object_main(["get", "bkt", "k1", "--out", str(out),
+                        "--root", root]) == 0
+    assert out.read_bytes() == b"cli" * 300
+    assert object_main(["ls", "bkt", "--root", root, "--json"]) == 0
+    listed = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert [o["key"] for o in listed] == ["k1"]
+    assert object_main(["stat", "bkt", "k1", "--root", root,
+                        "--json"]) == 0
+    assert object_main(["stat", "bkt", "--root", root, "--json"]) == 0
+    assert object_main(["compact", "bkt", "--root", root,
+                        "--force"]) == 0
+    assert object_main(["rm", "bkt", "k1", "--root", root]) == 0
+    assert object_main(["get", "bkt", "k1", "--root", root]) == 3
+    assert object_main(["get", "nosuch", "k", "--root", root]) == 3
+
+
+def test_rs_cli_dispatches_object(tmp_path):
+    from gpu_rscode_tpu.cli import main as rs_main
+
+    root = str(tmp_path / "root")
+    payload = tmp_path / "p.bin"
+    payload.write_bytes(b"x" * 64)
+    assert rs_main(["object", "put", "b", "k", "--in", str(payload),
+                    "--root", root]) == 0
+    assert rs_main(["object", "rm", "b", "k", "--root", root]) == 0
+
+
+# -- loadgen object surfaces --------------------------------------------------
+
+def test_loadgen_object_schedule_mix_and_zipf():
+    from gpu_rscode_tpu.serve.loadgen import _schedule, _zipf_weights
+
+    plan = _schedule(60.0, 20.0, [("a", 1.0)], decode_frac=0.2, seed=7,
+                     update_frac=0.1, object_frac=0.5)
+    ops = [op for _, _, op in plan]
+    n = len(ops)
+    assert 0.4 < ops.count("object") / n < 0.6
+    assert plan == _schedule(60.0, 20.0, [("a", 1.0)], 0.2, 7, 0.1, 0.5)
+    w = _zipf_weights(100, 1.1)
+    assert w[0] > w[10] > w[99] > 0
+
+
+def test_loadgen_object_ab_schema(tmp_path):
+    from gpu_rscode_tpu.serve.loadgen import run_object_ab
+
+    rows = run_object_ab(files=12, object_bytes=1024, k=3, p=2,
+                         batch=6, workdir=str(tmp_path), quiet=True)
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["object_ab", "object_ab", "object_ab_margin"]
+    facade, per_archive, margin = rows
+    assert facade["arm"] == "facade" and facade["verified"]
+    assert per_archive["arm"] == "per_archive" and per_archive["verified"]
+    assert margin["speedup"] is not None and margin["speedup"] > 0
+    # The metadata-amplification fact: per-archive writes (k+p+1) files
+    # per object, the facade a handful per stripe.
+    assert margin["disk_files_per_archive"] > \
+        margin["disk_files_facade"]
