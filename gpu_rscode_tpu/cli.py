@@ -12,7 +12,7 @@ ordering rule.
 
 Extensions (flagged long options, no reference equivalent):
 ``--generator {vandermonde,cauchy}``,
-``--strategy {auto,bitplane,table,pallas,xor,cpu}`` (default auto,
+``--strategy {auto,bitplane,table,pallas,xor,ring,cpu}`` (default auto,
 resolved per backend by the strategy autotuner: the fused pallas kernel
 on TPU hardware, meshes included — every fused dispatch is guarded with
 a bitplane fallback — bitplane elsewhere; RS_STRATEGY_AUTOTUNE=measure
@@ -46,11 +46,12 @@ Performance-tuning options:
          overridable via env RS_PALLAS_TILE
 [-s|-S]: pipeline depth (segments in flight, default 2)
 Extensions: [--generator vandermonde|cauchy]
-            [--strategy auto|bitplane|table|pallas|xor|cpu]  (default
-            auto: resolved by the per-backend strategy autotuner —
-            pallas kernel on TPU incl. meshes, bitplane elsewhere,
-            RS_STRATEGY_AUTOTUNE=measure to compete on timings;
-            xor = bitsliced XOR lowering, docs/XOR.md; cpu = host codec)
+            [--strategy auto|bitplane|table|pallas|xor|ring|cpu]
+            (default auto: resolved by the per-backend strategy
+            autotuner — pallas kernel on TPU incl. meshes, bitplane
+            elsewhere, RS_STRATEGY_AUTOTUNE=measure to compete on
+            timings; xor = bitsliced XOR lowering, docs/XOR.md;
+            ring = polynomial-ring lowering; cpu = host codec)
             [--segment-bytes N] [--quiet] [--profile-dir DIR]
             [--devices N] [--stripe S]  (shard over a device mesh;
             S > 1 additionally shards the stripe/k axis)
@@ -489,7 +490,7 @@ def _update_main(argv: list[str], op: str) -> int:
                           else "the bytes to append"))
     ap.add_argument("--strategy", default="auto",
                     choices=("auto", "bitplane", "table", "pallas", "xor",
-                             "cpu"))
+                             "ring", "cpu"))
     ap.add_argument("--segment-bytes", type=int, default=None,
                     help="column block sizing (default 64 MiB of natives)")
     ap.add_argument("--json", action="store_true",

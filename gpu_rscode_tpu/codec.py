@@ -79,9 +79,10 @@ class RSCodec:
     layer) resolves through the per-backend autotuner (:mod:`.tune`:
     pallas on real TPU hardware / bitplane elsewhere unless a measured
     decision says otherwise; ``RS_STRATEGY_AUTOTUNE=measure`` lets
-    table/bitplane/pallas/xor/native compete on real timings); explicit
-    values: "pallas", "bitplane" (MXU), "table" (VPU), "xor"
-    (XOR-lowered bitsliced planes, docs/XOR.md), "cpu" (native host
+    table/bitplane/pallas/xor/ring/native compete on real timings);
+    explicit values: "pallas", "bitplane" (MXU), "table" (VPU), "xor"
+    (XOR-lowered bitsliced planes, docs/XOR.md), "ring" (polynomial-
+    ring lowering, docs/XOR.md "Ring lowering"), "cpu" (native host
     codec).
     """
 
@@ -136,17 +137,18 @@ class RSCodec:
                 raise ValueError(
                     "strategy='cpu' is host-only; it cannot run on a device mesh"
                 )
-        if strategy == "xor":
+        if strategy in ("xor", "ring"):
             if w not in (8, 16):
                 raise ValueError(
-                    "strategy='xor' supports GF(2^8) and GF(2^16) only"
+                    f"strategy={strategy!r} supports GF(2^8) and "
+                    "GF(2^16) only"
                 )
             if mesh is not None:
                 raise ValueError(
-                    "strategy='xor' is single-device (its schedule is "
-                    "baked from concrete coefficients, which the jitted "
-                    "mesh collective cannot trace); use bitplane/table/"
-                    "pallas on a mesh"
+                    f"strategy={strategy!r} is single-device (its "
+                    "schedule is baked from concrete coefficients, "
+                    "which the jitted mesh collective cannot trace); "
+                    "use bitplane/table/pallas on a mesh"
                 )
         if mesh is not None:
             from .parallel.mesh import COLS, STRIPE
@@ -301,7 +303,7 @@ class RSCodec:
         from .ops import xor_gemm as _xg
 
         if (
-            self.strategy != "xor"
+            self.strategy not in ("xor", "ring")
             or self.mesh is not None
             or not _xg.pack_reuse_enabled()
             or not _plan.enabled()
@@ -332,13 +334,13 @@ class RSCodec:
             # A pre-packed plane handle (see pack_operand): only the xor
             # single-device plan path can consume it, and it is already
             # bucket-padded — dispatch directly, trimming to true cols.
-            if self.strategy != "xor" or self.mesh is not None:
+            if self.strategy not in ("xor", "ring") or self.mesh is not None:
                 raise ValueError(
-                    "packed operands require strategy='xor' on a "
-                    "single-device codec"
+                    "packed operands require strategy='xor' or 'ring' "
+                    "on a single-device codec"
                 )
             return _plan.dispatch(
-                A, B, w=self.w, strategy="xor", cap=B.cap,
+                A, B, w=self.w, strategy=self.strategy, cap=B.cap,
                 cols=B.cols_true,
             )
         seg = B if isinstance(B, _plan.StagedSegment) else None
@@ -428,11 +430,15 @@ class RSCodec:
                     cap=plan_cap, cols=b_cols,
                     donate=staged and seg.host is not None,
                 )
-            if self.strategy == "xor":
+            if self.strategy in ("xor", "ring"):
                 # Value-dependent schedule: the coefficients must stay
                 # concrete, so this path never rides gf_matmul_jit
                 # (which would trace A).  Works under a caller's jit
                 # too — only the DATA may be traced.
+                if self.strategy == "ring":
+                    from .ops.ring_gemm import gf_matmul_ring
+
+                    return gf_matmul_ring(A, B, self.w)
                 from .ops.xor_gemm import gf_matmul_xor
 
                 return gf_matmul_xor(A, B, self.w)

@@ -260,10 +260,10 @@ def sample_device_memory(force: bool = False) -> None:
 # the ROADMAP tracks — including the XOR-lowered strategy built to close
 # it (docs/XOR.md) — plus the native host codec ("native" is the analyze
 # surface's name for the codec's strategy="cpu").
-DEFAULT_STRATEGIES = ("table", "bitplane", "xor", "native")
+DEFAULT_STRATEGIES = ("table", "bitplane", "xor", "ring", "native")
 
 _STRATEGY_ALIASES = {"native": "cpu"}
-_ANALYZABLE = ("table", "bitplane", "pallas", "xor", "cpu")
+_ANALYZABLE = ("table", "bitplane", "pallas", "xor", "ring", "cpu")
 
 
 def _counter_value(snapshot: dict, name: str, **labels) -> float:
@@ -605,8 +605,8 @@ def main(argv=None) -> int:
     ap.add_argument("--strategies",
                     default=",".join(DEFAULT_STRATEGIES),
                     help="comma-separated strategy list (default "
-                    "table,bitplane,xor,native; 'native' is the host "
-                    "codec)")
+                    "table,bitplane,xor,ring,native; 'native' is the "
+                    "host codec)")
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--p", type=int, default=2)
     ap.add_argument("--w", type=int, default=8, choices=(8, 16))

@@ -253,6 +253,14 @@ def _strategies_section() -> dict:
             "cse_default": None,
             "schedules": [],
             "pipelines": 0,
+            "opt": None,
+        },
+        "ring": {
+            "supported_w": [8, 16],
+            "schedules": [],
+            "pipelines": 0,
+            "store": {},
+            "params": None,
         },
         "autotune_decisions": {},
         "store": {
@@ -301,6 +309,29 @@ def _strategies_section() -> dict:
         out["xor"]["cse_default"] = _xg._cse_enabled()
         out["xor"]["schedules"] = scheds
         out["xor"]["pipelines"] = len(_xg.pipeline_stats())
+        # Schedule-optimizer pass facts (ops/xor_opt.py): the resolved
+        # knob state plus per-pipeline stats — what the pass actually
+        # did (nodes moved, tile choice, unpack split) per compiled
+        # pipeline, xor and ring alike.
+        from ..ops import ring_gemm as _rg
+        from ..ops import xor_opt as _xopt
+
+        out["xor"]["opt"] = {
+            "enabled": _xopt.opt_enabled(),
+            "tile_override": _xopt.tile_override(),
+            "tile_budget_bytes": _xopt.tile_budget_bytes(),
+            "pipelines": [
+                {"digest": p_["digest"], **p_["opt"]}
+                for p_ in _xg.pipeline_stats() if p_.get("opt")
+            ] + [
+                {"digest": p_["digest"], **p_["opt"]}
+                for p_ in _rg.ring_pipeline_stats() if p_.get("opt")
+            ],
+        }
+        out["ring"]["schedules"] = _rg.ring_schedule_stats()
+        out["ring"]["pipelines"] = len(_rg.ring_pipeline_stats())
+        out["ring"]["store"] = _rg.ring_store_stats(load=True)
+        out["ring"]["params"] = _rg.ring_params(8)
         out["autotune_decisions"] = decisions
         # Persistent-store facts (docs/XOR.md "The persistent store"):
         # resolved path, on-disk schedule entries (load=True forces one

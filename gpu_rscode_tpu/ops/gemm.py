@@ -47,7 +47,7 @@ import numpy as np
 from .gf import get_field
 from .gf_jax import tables
 
-Strategy = Literal["bitplane", "table", "pallas", "xor", "cpu"]
+Strategy = Literal["bitplane", "table", "pallas", "xor", "ring", "cpu"]
 
 
 @functools.lru_cache(maxsize=None)
@@ -174,6 +174,11 @@ def gf_matmul(
         from .xor_gemm import gf_matmul_xor
 
         return gf_matmul_xor(A, B, w)
+    if strategy == "ring":
+        # Value-dependent like xor: concrete A only.
+        from .ring_gemm import gf_matmul_ring
+
+        return gf_matmul_ring(A, B, w)
     raise ValueError(f"unknown strategy {strategy!r}")
 
 

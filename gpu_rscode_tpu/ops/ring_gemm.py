@@ -1,0 +1,1007 @@
+"""Polynomial-ring GF(2^w) GEMM — ``strategy="ring"`` (docs/XOR.md).
+
+The ring lowering of arXiv 1701.07731 (Detchart & Lacan): embed each
+GF(2^w) symbol into the cyclic polynomial ring ``R_p = F2[x]/(x^p+1)``
+for a prime ``p`` with ``ord_p(2) = w``, where multiplying by ``x^s``
+is a CYCLIC SHIFT of the coefficient vector — at the packed bit-plane
+level a pure reindexing of the plane tuple, zero machine ops.  Every
+coefficient multiply then costs only the XOR of a few shifted copies
+(the coefficient's *lift weight*, ~2.2 avg for w=8) instead of a dense
+w x w bit-matrix.
+
+The embedding that keeps BYTE EQUIVALENCE with the repo's fields
+(primitive polys 0x11D / 0x1100B — the acceptance bar for every
+strategy) is the ring homomorphism ``psi: R_p -> GF(2^w), x -> g``
+with ``g`` an element of order p (``g = alpha^((2^w-1)/p)``):
+
+* ``psi`` is onto (g's minimal polynomial has degree w), its matrix
+  ``Psi`` is the w x p bit matrix with column t = bits(g^t);
+* ``{g^0..g^(w-1)}`` is an F2-basis, so ``u = sum_j c_j g^j`` with
+  ``c = M . bits(u)`` (``M`` = the basis matrix inverse) gives the
+  F2-linear lift ``L(u) = sum_j c_j x^j`` satisfying ``psi(L(u)) = u``;
+* each coefficient ``a`` lifts to its MINIMUM-WEIGHT preimage among
+  the ``2^(p-w)`` solutions of ``Psi z = bits(a)`` (exhaustive for
+  w=8's 512-element coset; greedy kernel descent for w=16).
+
+One dispatch is then three straight-line XOR programs over bit planes,
+compiled as ONE chain executable between the shared SWAR pack/unpack
+stages of :mod:`.xor_gemm`:
+
+1. **ring-in** — per input row, the w byte planes -> w coefficient
+   planes via ``M`` (the lift's top ``p - w`` planes are zero and never
+   materialise);
+2. **accumulate** — per output row r, ring plane ``t`` XORs plane
+   ``(t - s) mod p`` of every input i for every ``s`` in the lift
+   support of ``A[r, i]`` — the shifts live in the INDEX arithmetic,
+   so this stage is nothing but whole-plane XOR;
+3. **ring-out** — ``psi`` (+ the wrap-around reduction, already folded
+   into the index arithmetic) maps the active ring planes back to w
+   byte planes per output row.
+
+Each stage is Paar-CSE'd (same ``paar_cse``), the composite is cached
+by matrix digest in-process and in the persistent schedule store
+(``kind: "rs_ring_schedule"``, its own ``algo_version``), and the
+schedule-optimizer pass (ops/xor_opt.py, ``RS_XOR_OPT``) reorders /
+groups / tiles the emitted chain exactly as it does for xor.
+
+Where it stands (docs/XOR.md "Ring lowering" has the numbers): for the
+bench k=10/p=4 w=8 encode the ring trades xor's Paar-CSE'd bit-matrix
+terms for p/w = 17/8 more intermediate planes; on XLA CPU the extra
+plane traffic outweighs the cheaper coefficients, so autotune keeps
+picking xor there — the rung exists because the trade flips wherever
+whole-region XOR is relatively cheaper than many small ones.  w=16
+(p=257, a 16x plane expansion) is a correctness rung only.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass, replace
+
+import numpy as np
+
+from .gf import get_field
+from ..obs import metrics as _metrics
+from . import xor_gemm as _xg
+from .xor_gemm import (
+    _COL_ALIGN, PackedOperand, matrix_digest, padded_cols, paar_cse,
+)
+
+__all__ = [
+    "RingSchedule", "RingPipeline", "build_ring_schedule",
+    "gf_matmul_ring", "get_ring_pipeline", "clear_ring_caches",
+    "ring_schedule_stats", "ring_pipeline_stats", "ring_store_stats",
+    "ring_params",
+]
+
+_SUPPORTED_W = (8, 16)
+
+# (p, and the order-p generator exponent (2^w-1)/p) per width: p is the
+# smallest prime with ord_p(2) = w, so x^p+1 has a degree-w irreducible
+# factor and GF(2^w) contains an order-p element.
+_RING_P = {8: 17, 16: 257}
+
+
+# -- embedding context (pure numpy, cached per w) -----------------------------
+
+
+def _gf2_solve_affine(Mx: np.ndarray, b: np.ndarray):
+    """Particular solution + kernel basis of ``Mx z = b`` over GF(2)."""
+    rows, cols = Mx.shape
+    A = np.concatenate([Mx.copy(), b.reshape(-1, 1)], axis=1).astype(
+        np.uint8
+    )
+    pivots, r = [], 0
+    for c in range(cols):
+        piv = next((i for i in range(r, rows) if A[i, c]), None)
+        if piv is None:
+            continue
+        A[[r, piv]] = A[[piv, r]]
+        for i in range(rows):
+            if i != r and A[i, c]:
+                A[i] ^= A[r]
+        pivots.append(c)
+        r += 1
+        if r == rows:
+            break
+    if any(A[i, cols] for i in range(r, rows)):
+        raise ValueError("inconsistent GF(2) system")
+    z = np.zeros(cols, np.uint8)
+    for i, c in enumerate(pivots):
+        z[c] = A[i, cols]
+    ker = []
+    for f in (c for c in range(cols) if c not in pivots):
+        v = np.zeros(cols, np.uint8)
+        v[f] = 1
+        for i, c in enumerate(pivots):
+            v[c] = A[i, f]
+        ker.append(v)
+    return z, ker
+
+
+def _gf2_inv(Mx: np.ndarray) -> np.ndarray:
+    n = Mx.shape[0]
+    A = np.concatenate(
+        [Mx.copy().astype(np.uint8), np.eye(n, dtype=np.uint8)], axis=1
+    )
+    r = 0
+    for c in range(n):
+        piv = next((i for i in range(r, n) if A[i, c]), None)
+        if piv is None:
+            raise ValueError("singular GF(2) matrix")
+        A[[r, piv]] = A[[piv, r]]
+        for i in range(n):
+            if i != r and A[i, c]:
+                A[i] ^= A[r]
+        r += 1
+    return A[:, n:]
+
+
+class _RingCtx:
+    """Embedding data for one w: p, Psi (w x p), M (w x w), kernel."""
+
+    __slots__ = ("w", "p", "g", "psi", "m", "kernel", "_lifts", "_gf")
+
+    def __init__(self, w: int):
+        gf = get_field(w)
+        p = _RING_P[w]
+
+        def fmul(a, b):
+            return int(
+                np.asarray(
+                    gf.mul(
+                        np.array([a], gf.dtype), np.array([b], gf.dtype)
+                    )
+                )[0]
+            )
+
+        def fpow(a, e):
+            r, base = 1, a
+            while e:
+                if e & 1:
+                    r = fmul(r, base)
+                base = fmul(base, base)
+                e >>= 1
+            return r
+
+        g = fpow(2, (gf.size - 1) // p)  # alpha=2 is primitive for both
+        psi = np.zeros((w, p), np.uint8)
+        v = 1
+        for t in range(p):
+            for b in range(w):
+                psi[b, t] = (v >> b) & 1
+            v = fmul(v, g)
+        self.w, self.p, self.g = w, p, g
+        self.psi = psi
+        self.m = _gf2_inv(psi[:, :w])  # c = M . bits(u)
+        _, self.kernel = _gf2_solve_affine(
+            psi, np.zeros(w, np.uint8)
+        )
+        self._lifts: dict[int, np.ndarray] = {}
+        self._gf = gf
+
+    def lift(self, a: int) -> np.ndarray:
+        """Minimum-weight (w=8: exact; w=16: greedy) preimage of ``a``
+        under psi, as a p-length 0/1 vector."""
+        hit = self._lifts.get(a)
+        if hit is not None:
+            return hit
+        bits = np.array(
+            [(a >> b) & 1 for b in range(self.w)], np.uint8
+        )
+        z, ker = _gf2_solve_affine(self.psi, bits)
+        if self.w == 8:
+            # 2^(17-8) = 512 coset elements — exhaustive minimum.
+            K = np.array(ker, np.uint8)
+            best, bw = z, int(z.sum())
+            for mask in range(1, 1 << len(ker)):
+                v = z.copy()
+                mm, i = mask, 0
+                while mm:
+                    if mm & 1:
+                        v ^= K[i]
+                    mm >>= 1
+                    i += 1
+                wt = int(v.sum())
+                if wt < bw:
+                    best, bw = v, wt
+            z = best
+        else:
+            # Greedy steepest descent over the kernel basis — the
+            # 2^241 coset is not enumerable, but its size is exactly
+            # why low-weight members are dense (weights 1-4 observed
+            # for the test matrices).  Deterministic.
+            K = np.array(ker, np.uint8)
+            while True:
+                cand = z ^ K
+                wts = cand.sum(axis=1)
+                i = int(wts.argmin())
+                if wts[i] >= z.sum():
+                    break
+                z = cand[i]
+        self._lifts[a] = z
+        return z
+
+
+_CTX_CACHE: dict[int, _RingCtx] = {}
+_CTX_LOCK = threading.Lock()
+
+
+def _ctx(w: int) -> _RingCtx:
+    with _CTX_LOCK:
+        hit = _CTX_CACHE.get(w)
+        if hit is None:
+            hit = _CTX_CACHE[w] = _RingCtx(w)
+        return hit
+
+
+def ring_params(w: int) -> dict:
+    """Embedding facts for docs/doctor: p, generator, avg basis density."""
+    c = _ctx(w)
+    return {
+        "w": w,
+        "p": c.p,
+        "g": c.g,
+        "psi_density": round(float(c.psi.mean()), 4),
+        "plane_expansion": round(c.p / w, 4),
+    }
+
+
+# -- schedule -----------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RingSchedule:
+    """Three Paar-CSE'd straight-line XOR programs (hashable, immutable).
+
+    Stage s consumes the previous stage's output planes (stage 1: the
+    ``k * w`` packed byte planes) — ``sN_pairs[t] = (a, b)`` defines
+    node ``n_inputs_N + t``; ``sN_rows`` lists each output plane's term
+    nodes (empty tuple -> zero plane).  ``s2_planes`` records which
+    ``(out_row, ring_plane_t)`` each stage-2 output is — stage 3's term
+    indices point into that list.
+    """
+
+    digest: str
+    w: int
+    p: int
+    rows_out: int
+    k: int
+    n_inputs: int
+    s1_pairs: tuple
+    s1_rows: tuple
+    s2_pairs: tuple
+    s2_rows: tuple
+    s2_planes: tuple
+    s3_pairs: tuple
+    s3_rows: tuple
+    terms_naive: int
+    terms_cse: int
+    cse: bool
+    build_seconds: float
+
+    @property
+    def xors(self) -> int:
+        """XOR ops one dispatch evaluates (per packed word column)."""
+        return sum(
+            len(pairs) + sum(max(0, len(r) - 1) for r in rows)
+            for pairs, rows in (
+                (self.s1_pairs, self.s1_rows),
+                (self.s2_pairs, self.s2_rows),
+                (self.s3_pairs, self.s3_rows),
+            )
+        )
+
+    @property
+    def stage_payloads(self) -> tuple:
+        return (
+            (self.s1_pairs, self.s1_rows),
+            (self.s2_pairs, self.s2_rows),
+            (self.s3_pairs, self.s3_rows),
+        )
+
+
+_SCHEDULE_CACHE: dict[tuple, RingSchedule] = {}
+_SCHEDULE_LOCK = threading.Lock()
+
+
+# Paar's elimination argmaxes an O((n_inputs + pairs)^2) co-occurrence
+# counter per extracted pair; ring stage programs can carry thousands of
+# input planes (the p/w expansion — stage 3 of a w=16 decode sees one
+# input per ACTIVE ring plane), where that turns into minutes of
+# elimination for single-digit XOR savings.  Stages past this size run
+# naive: byte-identical output, just no shared nodes.
+_CSE_STAGE_BOUND = 2048
+
+
+def _stage_program(row_sets, n_inputs: int, cse: bool):
+    """(pair_ops, rows) for one stage, Paar-CSE'd when enabled."""
+    sets = [set(s) for s in row_sets]
+    if cse and 0 < max(n_inputs, len(sets)) <= _CSE_STAGE_BOUND \
+            and n_inputs > 0:
+        pair_ops, sets = paar_cse(sets, n_inputs)
+    else:
+        pair_ops = []
+    return (
+        tuple(pair_ops),
+        tuple(tuple(int(t) for t in sorted(s)) for s in sets),
+    )
+
+
+# -- persistent store (kind: rs_ring_schedule) --------------------------------
+#
+# Same contract as the xor store (docs/XOR.md "The persistent store"):
+# pure data keyed by (digest, cse, algo version), every load fully
+# validated, corruption recomputes.  v1 is the first ring algorithm;
+# records carry an explicit ``algo_version`` from day one.
+
+_STORE_ALGO = 1
+
+_STORE_LOCK = threading.Lock()
+_STORE_INDEX: dict[tuple, dict] | None = None
+_STORE_STATS = {"hits": 0, "misses": 0, "stored": 0, "corrupt": 0,
+                "built": 0}
+
+
+def _count_store(outcome: str) -> None:
+    _metrics.counter(
+        "rs_ring_schedule_store_total",
+        "persistent ring-schedule store lookups by outcome",
+    ).labels(outcome=outcome).inc()
+
+
+def _store_path() -> str | None:
+    from ..obs import runlog as _runlog
+
+    return _runlog.store_path()
+
+
+def _rec_ts(rec: dict) -> float:
+    try:
+        return float(rec.get("ts") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+def _store_index() -> dict[tuple, dict]:
+    global _STORE_INDEX
+    with _STORE_LOCK:
+        if _STORE_INDEX is not None:
+            return _STORE_INDEX
+    p = _store_path()
+    idx: dict[tuple, dict] = {}
+    if p:
+        from ..obs import runlog as _runlog
+
+        for rec in _runlog.read_records(p):
+            if rec.get("kind") != "rs_ring_schedule":
+                continue
+            digest = rec.get("digest")
+            if not isinstance(digest, str):
+                continue
+            key = (digest, bool(rec.get("cse")))
+            cur = idx.get(key)
+            if cur is None or _rec_ts(rec) >= _rec_ts(cur):
+                idx[key] = rec
+    with _STORE_LOCK:
+        if _STORE_INDEX is None:
+            _STORE_INDEX = idx
+        return _STORE_INDEX
+
+
+def _reset_store_index() -> None:
+    global _STORE_INDEX
+    with _STORE_LOCK:
+        _STORE_INDEX = None
+
+
+def _payload_digest(sched_fields: dict) -> str:
+    h = hashlib.blake2b(digest_size=8)
+    h.update(
+        json.dumps(sched_fields, separators=(",", ":")).encode()
+    )
+    return h.hexdigest()
+
+
+def _stage_fields(s1_pairs, s1_rows, s2_pairs, s2_rows, s2_planes,
+                  s3_pairs, s3_rows) -> dict:
+    return {
+        "s1_pairs": [list(x) for x in s1_pairs],
+        "s1_rows": [list(r) for r in s1_rows],
+        "s2_pairs": [list(x) for x in s2_pairs],
+        "s2_rows": [list(r) for r in s2_rows],
+        "s2_planes": [list(x) for x in s2_planes],
+        "s3_pairs": [list(x) for x in s3_pairs],
+        "s3_rows": [list(r) for r in s3_rows],
+    }
+
+
+def _validate_stage(pair_ops, rows, n_inputs: int, n_rows: int | None):
+    for t, (a, b) in enumerate(pair_ops):
+        if not (0 <= a < n_inputs + t and 0 <= b < n_inputs + t):
+            raise ValueError("pair op references an undefined node")
+    n_nodes = n_inputs + len(pair_ops)
+    for r in rows:
+        for t in r:
+            if not 0 <= t < n_nodes:
+                raise ValueError("row term references an undefined node")
+    if n_rows is not None and len(rows) != n_rows:
+        raise ValueError("stage row count inconsistent")
+
+
+def _schedule_from_store(digest: str, cse: bool, A: np.ndarray,
+                         w: int) -> RingSchedule | None:
+    if not _store_path():
+        return None
+    rec = _store_index().get((digest, cse))
+    if rec is None:
+        with _STORE_LOCK:
+            _STORE_STATS["misses"] += 1
+        _count_store("miss")
+        return None
+    try:
+        if rec.get("algo_version") != _STORE_ALGO:
+            raise ValueError("algorithm version mismatch")
+        rows_out, k = int(rec["rows_out"]), int(rec["k"])
+        n_inputs, p = int(rec["n_inputs"]), int(rec["p"])
+        if (int(rec["w"]), rows_out, k) != (w, A.shape[0], A.shape[1]):
+            raise ValueError("shape fields disagree with the matrix")
+        if n_inputs != k * w or p != _RING_P[w]:
+            raise ValueError("ring parameters inconsistent with (k, w)")
+        s1_pairs = tuple((int(a), int(b)) for a, b in rec["s1_pairs"])
+        s1_rows = tuple(
+            tuple(int(t) for t in r) for r in rec["s1_rows"]
+        )
+        s2_pairs = tuple((int(a), int(b)) for a, b in rec["s2_pairs"])
+        s2_rows = tuple(
+            tuple(int(t) for t in r) for r in rec["s2_rows"]
+        )
+        s2_planes = tuple(
+            (int(r_), int(t)) for r_, t in rec["s2_planes"]
+        )
+        s3_pairs = tuple((int(a), int(b)) for a, b in rec["s3_pairs"])
+        s3_rows = tuple(
+            tuple(int(t) for t in r) for r in rec["s3_rows"]
+        )
+        _validate_stage(s1_pairs, s1_rows, n_inputs, k * w)
+        _validate_stage(s2_pairs, s2_rows, k * w, len(s2_planes))
+        if len(s2_rows) != len(s2_planes):
+            raise ValueError("stage-2 plane map inconsistent")
+        for r_, t in s2_planes:
+            if not (0 <= r_ < rows_out and 0 <= t < p):
+                raise ValueError("stage-2 plane id out of range")
+        _validate_stage(
+            s3_pairs, s3_rows, len(s2_planes), rows_out * w
+        )
+        fields = _stage_fields(
+            s1_pairs, s1_rows, s2_pairs, s2_rows, s2_planes,
+            s3_pairs, s3_rows,
+        )
+        if rec.get("payload_digest") != _payload_digest(fields):
+            raise ValueError("payload checksum mismatch")
+        sched = RingSchedule(
+            digest=digest, w=w, p=p, rows_out=rows_out, k=k,
+            n_inputs=n_inputs,
+            s1_pairs=s1_pairs, s1_rows=s1_rows,
+            s2_pairs=s2_pairs, s2_rows=s2_rows, s2_planes=s2_planes,
+            s3_pairs=s3_pairs, s3_rows=s3_rows,
+            terms_naive=int(rec["terms_naive"]),
+            terms_cse=int(rec["terms_cse"]),
+            cse=cse, build_seconds=0.0,
+        )
+    except Exception:
+        with _STORE_LOCK:
+            if _STORE_INDEX is not None:
+                _STORE_INDEX.pop((digest, cse), None)
+            _STORE_STATS["corrupt"] += 1
+        _count_store("corrupt")
+        return None
+    with _STORE_LOCK:
+        _STORE_STATS["hits"] += 1
+    _count_store("hit")
+    return sched
+
+
+def _schedule_to_store(sched: RingSchedule) -> None:
+    p = _store_path()
+    if not p:
+        return
+    key = (sched.digest, sched.cse)
+    idx = _store_index()
+    if key in idx:
+        return
+    from ..obs import runlog as _runlog
+
+    fields = _stage_fields(
+        sched.s1_pairs, sched.s1_rows, sched.s2_pairs, sched.s2_rows,
+        sched.s2_planes, sched.s3_pairs, sched.s3_rows,
+    )
+    rec = {
+        "kind": "rs_ring_schedule",
+        "schema": _runlog.SCHEMA_VERSION,
+        "algo_version": _STORE_ALGO,
+        "digest": sched.digest,
+        "cse": sched.cse,
+        "w": sched.w,
+        "p": sched.p,
+        "rows_out": sched.rows_out,
+        "k": sched.k,
+        "n_inputs": sched.n_inputs,
+        **fields,
+        "payload_digest": _payload_digest(fields),
+        "terms_naive": sched.terms_naive,
+        "terms_cse": sched.terms_cse,
+        "build_seconds": round(sched.build_seconds, 6),
+        "ts": time.time(),
+        "run": _runlog.run_id(),
+        "host": socket.gethostname(),
+    }
+    _runlog.append(rec, p)
+    with _STORE_LOCK:
+        if _STORE_INDEX is not None:
+            _STORE_INDEX[key] = rec
+        _STORE_STATS["stored"] += 1
+    _count_store("stored")
+
+
+def ring_store_stats(load: bool = False) -> dict:
+    """Ring store facts for `rs doctor` (mirrors xor store_stats)."""
+    p = _store_path()
+    if load and p:
+        _store_index()
+    with _STORE_LOCK:
+        entries = (
+            len(_STORE_INDEX) if _STORE_INDEX is not None else None
+        )
+        out = dict(_STORE_STATS)
+    out.update({"path": p, "enabled": p is not None, "entries": entries})
+    return out
+
+
+# -- schedule build -----------------------------------------------------------
+
+
+def build_ring_schedule(A, w: int, cse: bool | None = None) -> RingSchedule:
+    """Ring-lower ``A`` into the three stage programs — cached by digest
+    in-process, then by the persistent store, then computed."""
+    if w not in _SUPPORTED_W:
+        raise ValueError(
+            f"strategy='ring' supports w in {_SUPPORTED_W}, got w={w}"
+        )
+    if cse is None:
+        cse = _xg._cse_enabled()
+    A = np.asarray(A)
+    digest = matrix_digest(A, w)
+    key = (digest, bool(cse))
+    with _SCHEDULE_LOCK:
+        hit = _SCHEDULE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    loaded = _schedule_from_store(digest, bool(cse), A, w)
+    if loaded is not None:
+        with _SCHEDULE_LOCK:
+            return _SCHEDULE_CACHE.setdefault(key, loaded)
+    with _STORE_LOCK:
+        _STORE_STATS["built"] += 1
+    t0 = time.perf_counter()
+    ctx = _ctx(w)
+    p = ctx.p
+    rows_out, k = A.shape
+    n_inputs = k * w
+
+    # Stage 1 — ring-in: c_{i,j} = sum_b M[j,b] u_{i,b}.
+    s1_sets = [
+        {i * w + b for b in np.flatnonzero(ctx.m[j])}
+        for i in range(k) for j in range(w)
+    ]
+
+    # Stage 2 — shift-accumulate: ring plane (r, t) is the parity-XOR
+    # of c-planes (i, j) over every lift support s with (j+s) % p == t.
+    # The cyclic shifts are pure index arithmetic here — the executable
+    # never shifts anything.
+    terms: list[dict[int, set[int]]] = [dict() for _ in range(rows_out)]
+    for r in range(rows_out):
+        for i in range(k):
+            a = int(A[r, i])
+            if not a:
+                continue
+            lift = ctx.lift(a)
+            for s in np.flatnonzero(lift):
+                for j in range(w):
+                    t = (j + int(s)) % p
+                    bucket = terms[r].setdefault(t, set())
+                    c_idx = i * w + j
+                    if c_idx in bucket:
+                        bucket.discard(c_idx)  # parity cancellation
+                    else:
+                        bucket.add(c_idx)
+    s2_planes: list[tuple[int, int]] = []
+    s2_sets: list[set[int]] = []
+    for r in range(rows_out):
+        for t in sorted(terms[r]):
+            if terms[r][t]:
+                s2_planes.append((r, t))
+                s2_sets.append(terms[r][t])
+    plane_index = {rt: i for i, rt in enumerate(s2_planes)}
+
+    # Stage 3 — ring-out: bits(out_r)[b] = sum_t Psi[b,t] S_r[t]
+    # (inactive ring planes are identically zero and drop out).
+    s3_sets = []
+    for r in range(rows_out):
+        for b in range(w):
+            s3_sets.append({
+                plane_index[(r, t)]
+                for t in np.flatnonzero(ctx.psi[b])
+                if (r, int(t)) in plane_index
+            })
+
+    naive = sum(len(s) for s in s1_sets + s2_sets + s3_sets)
+    limit = _xg._max_terms()
+    if naive > limit:
+        raise ValueError(
+            f"ring schedule for {rows_out}x{k} w={w} needs {naive} XOR "
+            f"terms, over RS_XOR_MAX_TERMS={limit}; use strategy='xor' "
+            "(or raise the knob) for matrices this large"
+        )
+    s1_pairs, s1_rows = _stage_program(s1_sets, n_inputs, bool(cse))
+    s2_pairs, s2_rows = _stage_program(s2_sets, n_inputs, bool(cse))
+    s3_pairs, s3_rows = _stage_program(
+        s3_sets, len(s2_planes), bool(cse)
+    )
+    terms_cse = (
+        len(s1_pairs) + len(s2_pairs) + len(s3_pairs)
+        + sum(len(r) for r in s1_rows + s2_rows + s3_rows)
+    )
+    dt = time.perf_counter() - t0
+    _metrics.quantile(
+        "rs_ring_schedule_build_seconds",
+        "ring-schedule lowering+CSE wall seconds (streaming quantiles)",
+    ).observe(dt)
+    sched = RingSchedule(
+        digest=digest, w=w, p=p, rows_out=rows_out, k=k,
+        n_inputs=n_inputs,
+        s1_pairs=s1_pairs, s1_rows=s1_rows,
+        s2_pairs=s2_pairs, s2_rows=s2_rows,
+        s2_planes=tuple(s2_planes),
+        s3_pairs=s3_pairs, s3_rows=s3_rows,
+        terms_naive=naive, terms_cse=terms_cse,
+        cse=bool(cse), build_seconds=dt,
+    )
+    _schedule_to_store(sched)
+    with _SCHEDULE_LOCK:
+        return _SCHEDULE_CACHE.setdefault(key, sched)
+
+
+def ring_schedule_stats() -> list[dict]:
+    """Built ring schedules — the `rs doctor` surface."""
+    with _SCHEDULE_LOCK:
+        scheds = list(_SCHEDULE_CACHE.values())
+    return [
+        {
+            "digest": s.digest,
+            "w": s.w,
+            "p": s.p,
+            "rows_out": s.rows_out,
+            "k": s.k,
+            "cse": s.cse,
+            "ring_planes": len(s.s2_planes),
+            "terms_naive": s.terms_naive,
+            "terms_cse": s.terms_cse,
+            "xors": s.xors,
+            "build_seconds": round(s.build_seconds, 6),
+        }
+        for s in scheds
+    ]
+
+
+# -- chain emission -----------------------------------------------------------
+
+
+def _emit_slp(inputs, pair_ops, rows, zero_ref):
+    """One straight-line XOR program: inputs + pair nodes -> row trees.
+    ``zero_ref`` shapes the zero planes of empty rows — a stage fed by
+    an all-zero coefficient row can have NO inputs at all."""
+    import jax.numpy as jnp
+
+    nodes = list(inputs)
+    for a, b in pair_ops:
+        nodes.append(nodes[a] ^ nodes[b])
+    return tuple(
+        _xg._xor_tree([nodes[t] for t in terms]) if terms
+        else jnp.zeros_like(zero_ref)
+        for terms in rows
+    )
+
+
+def _ring_chain_stage(nodes, sched: RingSchedule):
+    """ring-in |> shift-accumulate |> ring-out, one traced program."""
+    ref = nodes[0]
+    c = _emit_slp(nodes, sched.s1_pairs, sched.s1_rows, ref)
+    s2 = _emit_slp(c, sched.s2_pairs, sched.s2_rows, ref)
+    return _emit_slp(s2, sched.s3_pairs, sched.s3_rows, ref)
+
+
+# -- compiled pipeline --------------------------------------------------------
+
+
+class RingPipeline:
+    """pack |> ring chain |> unpack for one (schedule, k, cols, dtype).
+
+    Same shell as :class:`..ops.xor_gemm.XorPipeline` — the pack /
+    unpack executables ARE xor's (shared per-class stage cache; a
+    :class:`PackedOperand` packed for xor feeds ring unchanged), only
+    the chain differs.  The optimizer pass applies per stage program
+    and tiles the whole chain.
+    """
+
+    __slots__ = (
+        "schedule", "k", "cols", "dtype", "compile_seconds",
+        "cost_analysis", "calls", "opt", "_pack", "_chain", "_unpack",
+        "_pieces", "_assemble",
+    )
+
+    def __init__(self, schedule: RingSchedule, k: int, cols: int, dtype):
+        import jax
+
+        from . import xor_opt as _xopt
+
+        if cols % _COL_ALIGN:
+            raise ValueError(
+                f"ring pipeline cols must be {_COL_ALIGN}-aligned, "
+                f"got {cols}"
+            )
+        self.schedule = schedule
+        self.k = k
+        self.cols = cols
+        self.dtype = np.dtype(dtype)
+        self.calls = 0
+        t0 = time.perf_counter()
+        w = schedule.w
+        emit = schedule
+        n_planes = schedule.n_inputs + sum(
+            len(pairs) + len(rows)
+            for pairs, rows in schedule.stage_payloads
+        )
+        nw = cols // _COL_ALIGN
+        if _xopt.opt_enabled():
+            moved = groups = 0
+            fields = {}
+            for name, n_in in (
+                ("s1", schedule.n_inputs),
+                ("s2", schedule.n_inputs),
+                ("s3", len(schedule.s2_planes)),
+            ):
+                pairs, rows, mv, gr = _xopt.optimize_program(
+                    getattr(schedule, f"{name}_pairs"),
+                    getattr(schedule, f"{name}_rows"),
+                    n_in,
+                )
+                fields[f"{name}_pairs"] = pairs
+                fields[f"{name}_rows"] = rows
+                moved += mv
+                groups += gr
+            emit = replace(schedule, **fields)
+            tile, n_tiles, ws = _xopt.choose_tile(n_planes, nw)
+            self.opt = _xopt.OptStats(
+                enabled=True, nodes_moved=moved, term_groups=groups,
+                tile_words=tile, n_tiles=n_tiles,
+                est_working_set_bytes=ws,
+                split_unpack=_xopt.split_unpack(nw),
+            )
+        else:
+            self.opt = _xopt.disabled_stats()
+        self._pack = _xg._pack_exe(k, cols, self.dtype, w)
+        nodes_struct = tuple(
+            [_xg._plane_struct(cols)] * (k * w)
+        )
+        tile = self.opt.tile_words
+        if tile:
+            # The xor tiled-scan walker takes any object with
+            # ``pair_ops``/``rows`` — adapt the three-stage chain by
+            # running it as the block function via a shim schedule.
+            chain_fn = (
+                lambda ns: _tiled_ring_chain(ns, emit, tile)
+            )
+        else:
+            chain_fn = lambda ns: _ring_chain_stage(ns, emit)
+        self._chain = (
+            jax.jit(chain_fn).lower(nodes_struct).compile()
+        )
+        if self.opt.split_unpack:
+            self._unpack = None
+            self._pieces = _xg._pieces_exe(schedule.rows_out, cols, w)
+            self._assemble = _xg._assemble_exe(
+                schedule.rows_out, cols, w
+            )
+        else:
+            self._unpack = _xg._unpack_exe(schedule.rows_out, cols, w)
+            self._pieces = self._assemble = None
+        self.compile_seconds = time.perf_counter() - t0
+        self.cost_analysis = self._merged_cost()
+
+    def _merged_cost(self):
+        from ..obs.attrib import extract_cost_analysis
+
+        stages = (
+            (self._pack, self._chain, self._unpack)
+            if self._unpack is not None
+            else (self._pack, self._chain, self._pieces, self._assemble)
+        )
+        total: dict = {}
+        for exe in stages:
+            ca = extract_cost_analysis(exe)
+            if not ca:
+                return None
+            for key, v in ca.items():
+                total[key] = total.get(key, 0.0) + v
+        return total or None
+
+    def __call__(self, A, B):
+        self.calls += 1
+        if isinstance(B, PackedOperand):
+            if (B.rows, B.cols, B.w) != (
+                self.k, self.cols, self.schedule.w
+            ) or B.dtype != self.dtype:
+                raise ValueError(
+                    f"packed operand ({B.rows}x{B.cols}, w={B.w}, "
+                    f"{B.dtype}) does not match pipeline "
+                    f"({self.k}x{self.cols}, w={self.schedule.w}, "
+                    f"{self.dtype})"
+                )
+            _xg._count_pack_reuse("reused")
+            planes = B.planes
+        else:
+            _xg._count_pack_reuse("packed")
+            planes = _xg._observed_pack(self._pack, B)
+        outs = self._chain(planes)
+        if self._unpack is not None:
+            return self._unpack(outs)
+        return self._assemble(self._pieces(outs))
+
+    def describe(self) -> dict:
+        s = self.schedule
+        return {
+            "digest": s.digest,
+            "w": s.w,
+            "p": s.p,
+            "k": self.k,
+            "rows_out": s.rows_out,
+            "cols": self.cols,
+            "cse": s.cse,
+            "ring_planes": len(s.s2_planes),
+            "terms_naive": s.terms_naive,
+            "terms_cse": s.terms_cse,
+            "xors": s.xors,
+            "calls": self.calls,
+            "compile_seconds": round(self.compile_seconds, 6),
+            "opt": self.opt.as_dict(),
+        }
+
+
+def _tiled_ring_chain(nodes, sched: RingSchedule, tile: int):
+    """Region-tiled three-stage ring chain (ops/xor_opt.py): same scan
+    shape as xor's tiled chain, with the composite stage program as the
+    per-tile block."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    nodes = tuple(nodes)
+    nw = nodes[0].shape[0]
+    nt, tail = nw // tile, nw % tile
+
+    def step(carry, t):
+        off = t * tile
+        sl = tuple(
+            lax.dynamic_slice(p_, (off,), (tile,)) for p_ in nodes
+        )
+        outs = _ring_chain_stage(sl, sched)
+        carry = tuple(
+            lax.dynamic_update_slice(c, o, (off,))
+            for c, o in zip(carry, outs)
+        )
+        return carry, None
+
+    init = tuple(
+        jnp.zeros((nw,), nodes[0].dtype)
+        for _ in range(sched.rows_out * sched.w)
+    )
+    out, _ = lax.scan(step, init, jnp.arange(nt))
+    if tail:
+        sl = tuple(p_[nt * tile:] for p_ in nodes)
+        outs = _ring_chain_stage(sl, sched)
+        out = tuple(
+            lax.dynamic_update_slice(c, o, (nt * tile,))
+            for c, o in zip(out, outs)
+        )
+    return out
+
+
+_PIPELINE_CACHE: dict[tuple, RingPipeline] = {}
+_PIPELINE_LOCK = threading.Lock()
+
+
+def get_ring_pipeline(A, B_shape, B_dtype, w: int) -> RingPipeline:
+    """Build-or-fetch the compiled ring pipeline for concrete ``A`` and
+    a (k, cols) operand class (cols 32-aligned, see padded_cols)."""
+    from . import xor_opt as _xopt
+
+    schedule = build_ring_schedule(A, w)
+    k, cols = B_shape
+    key = (
+        schedule.digest, schedule.cse, k, cols,
+        np.dtype(B_dtype).str, _xopt.env_fingerprint(),
+    )
+    with _PIPELINE_LOCK:
+        pipe = _PIPELINE_CACHE.get(key)
+        if pipe is None:
+            pipe = _PIPELINE_CACHE[key] = RingPipeline(
+                schedule, k, cols, B_dtype
+            )
+        return pipe
+
+
+def clear_ring_caches() -> None:
+    """Drop ring pipelines + schedules and forget the store index (the
+    store FILE survives — pure data, revalidated on next load).  Runs
+    automatically with :func:`..ops.xor_gemm.clear_pipeline_cache`
+    (registered hook): ring pipelines pin stage executables from xor's
+    just-cleared shared cache."""
+    with _PIPELINE_LOCK:
+        _PIPELINE_CACHE.clear()
+    with _SCHEDULE_LOCK:
+        _SCHEDULE_CACHE.clear()
+    _reset_store_index()
+
+
+_xg.register_clear_hook(clear_ring_caches)
+
+
+def ring_pipeline_stats() -> list[dict]:
+    with _PIPELINE_LOCK:
+        pipes = list(_PIPELINE_CACHE.values())
+    return [p.describe() for p in pipes]
+
+
+def gf_matmul_ring(A, B, w: int = 8):
+    """``C = A . B`` over GF(2^w) via the ring pipeline (eager entry).
+
+    Same contract as :func:`..ops.xor_gemm.gf_matmul_xor`: ``A`` must
+    be concrete (its values select the schedule), ``B`` may be traced
+    (the stage programs inline under the caller's jit), ragged widths
+    pad to the 32-symbol alignment and trim after.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    if isinstance(A, jax.core.Tracer):
+        raise TypeError(
+            "strategy='ring' needs concrete coefficient values to build "
+            "its ring schedule; call it outside jit (or via the plan "
+            "layer), not on a traced A"
+        )
+    A = np.asarray(A)
+    gf = get_field(w)
+    dtype = np.dtype(gf.dtype)
+    rows_out, k = A.shape
+    m = B.shape[1]
+    if m == 0:
+        return jnp.zeros((rows_out, 0), dtype=dtype)
+    cols = padded_cols(m)
+    if B.shape[1] != cols:
+        B = jnp.asarray(B)
+        B = jnp.pad(B, ((0, 0), (0, cols - m)))
+    if isinstance(B, jax.core.Tracer):
+        schedule = build_ring_schedule(A, w)
+        out = _xg._unpack_stage(
+            _ring_chain_stage(_xg._pack_stage(B, w), schedule),
+            schedule.w, schedule.rows_out, cols,
+        )
+    else:
+        pipe = get_ring_pipeline(A, (k, cols), dtype, w)
+        out = pipe(A, B)
+    return out[:, :m] if cols != m else out

@@ -73,6 +73,13 @@ Env knobs (read at schedule build / pipeline compile time):
   a path overrides the default (the ``RS_RUNLOG`` ledger).
 * ``RS_XOR_PACK_REUSE=0`` — disable packed-operand reuse (callers fall
   back to per-dispatch packing; A/B escape hatch).
+* ``RS_XOR_OPT=0`` — disable the schedule-optimizer pass
+  (ops/xor_opt.py: demand-driven node reordering, access-pattern term
+  grouping, chain region tiling, unpack splitting — byte-identical
+  output either way, the pass only rewrites emission).
+* ``RS_XOR_TILE`` / ``RS_XOR_TILE_BUDGET`` — force the chain tile
+  width in packed words (0 = untiled) / set the cache budget the auto
+  tile choice targets (default 2 MiB).  See ops/xor_opt.py.
 """
 
 from __future__ import annotations
@@ -84,7 +91,7 @@ import os
 import socket
 import threading
 import time
-from dataclasses import dataclass
+from dataclasses import dataclass, replace
 
 import numpy as np
 
@@ -263,7 +270,15 @@ _SCHEDULE_LOCK = threading.Lock()
 # node-index bounds and the payload checksum, so a torn ledger line or a
 # foreign record recomputes instead of crashing or mis-scheduling.
 
-_STORE_ALGO = 1  # bump when the lowering/CSE output format changes
+# Bumped when the lowering/CSE/optimizer output contract changes.  v2:
+# the schedule-optimizer pass (ops/xor_opt.py) landed — stored payloads
+# are still the CANONICAL post-CSE program (the optimizer rewrites at
+# pipeline-emission time, so one stored schedule serves RS_XOR_OPT on
+# AND off), but records now carry an explicit ``algo_version`` field and
+# loads check it FIRST: a record written before the optimizer existed
+# must recompute even if its payload digest validates, never be trusted
+# to match the current emission contract.
+_STORE_ALGO = 2
 
 _STORE_LOCK = threading.Lock()
 _STORE_INDEX: dict[tuple, dict] | None = None  # (digest, cse) -> record
@@ -356,8 +371,15 @@ def _schedule_from_store(digest: str, cse: bool, A: np.ndarray,
         _count_store("miss")
         return None
     try:
-        if rec.get("algo") != _STORE_ALGO:
+        # Explicit algorithm-version gate, checked before anything else:
+        # pre-optimizer records (algo_version absent or < 2) carry a
+        # payload whose digest may well validate — digest proves the
+        # record is intact, not that it matches the current emission
+        # contract — so the version field is authoritative on its own.
+        if rec.get("algo_version") != _STORE_ALGO:
             raise ValueError("algorithm version mismatch")
+        if rec.get("algo") != _STORE_ALGO:
+            raise ValueError("legacy algo field disagrees")
         rows_out, k = int(rec["rows_out"]), int(rec["k"])
         n_inputs = int(rec["n_inputs"])
         if (int(rec["w"]), rows_out, k) != (w, A.shape[0], A.shape[1]):
@@ -421,6 +443,7 @@ def _schedule_to_store(sched: XorSchedule) -> None:
         "kind": "rs_xor_schedule",
         "schema": _runlog.SCHEMA_VERSION,
         "algo": _STORE_ALGO,
+        "algo_version": _STORE_ALGO,
         "digest": sched.digest,
         "cse": sched.cse,
         "w": sched.w,
@@ -697,20 +720,81 @@ def _chain_stage(nodes, schedule: XorSchedule):
     )
 
 
-def _unpack_stage(outs, w: int, rows_out: int, cols: int):
+def _tiled_chain_stage(nodes, schedule: XorSchedule, tile: int):
+    """The chain as a ``lax.scan`` over contiguous column tiles of the
+    plane vectors (ops/xor_opt.py "region tiling"): per step every input
+    plane is sliced to ``tile`` words, the whole XOR program runs on the
+    slices — live set sized to the cache budget — and the outputs are
+    written back at the tile offset.  A non-dividing remainder runs as
+    one static tail after the scan.  Byte-identical to
+    :func:`_chain_stage` (same program, blocked evaluation)."""
     import jax.numpy as jnp
     from jax import lax
 
+    nodes = tuple(nodes)
+    nw = nodes[0].shape[0]
+    nt, tail = nw // tile, nw % tile
+
+    def _block(sl):
+        return _chain_stage(sl, schedule)
+
+    def step(carry, t):
+        off = t * tile
+        sl = tuple(
+            lax.dynamic_slice(p_, (off,), (tile,)) for p_ in nodes
+        )
+        outs = _block(sl)
+        carry = tuple(
+            lax.dynamic_update_slice(c, o, (off,))
+            for c, o in zip(carry, outs)
+        )
+        return carry, None
+
+    init = tuple(
+        jnp.zeros((nw,), nodes[0].dtype) for _ in schedule.rows
+    )
+    out, _ = lax.scan(step, init, jnp.arange(nt))
+    if tail:
+        sl = tuple(p_[nt * tile:] for p_ in nodes)
+        outs = _block(sl)
+        out = tuple(
+            lax.dynamic_update_slice(c, o, (nt * tile,))
+            for c, o in zip(out, outs)
+        )
+    return out
+
+
+def _pieces_stage(outs, w: int, rows_out: int):
+    """Unpack's SWAR half only: output planes -> contiguous uint32
+    pieces (row-major, in concatenation order) with NO assembly.  Kept
+    in its own executable when the optimizer splits the unpack — fused
+    with the concatenate, XLA CPU re-runs the transform per concatenate
+    operand (see ops/xor_opt.py)."""
     pieces = []
     for ri in range(rows_out):
         pieces.extend(_unpack_row_pieces(outs[ri * w:(ri + 1) * w], w))
-    words = jnp.concatenate(pieces)
+    return tuple(pieces)
+
+
+def _assemble_stage(pieces, w: int, rows_out: int, cols: int):
+    """Unpack's assembly half: concatenate the materialised pieces and
+    bitcast back to symbols."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    words = jnp.concatenate(list(pieces))
     if w == 8:
         return lax.bitcast_convert_type(words, jnp.uint8).reshape(
             rows_out, cols
         )
     return lax.bitcast_convert_type(words, jnp.uint16).reshape(
         rows_out, cols
+    )
+
+
+def _unpack_stage(outs, w: int, rows_out: int, cols: int):
+    return _assemble_stage(
+        _pieces_stage(outs, w, rows_out), w, rows_out, cols
     )
 
 
@@ -762,6 +846,44 @@ def _unpack_exe(rows_out: int, cols: int, w: int):
     exe = (
         jax.jit(lambda os_: _unpack_stage(os_, w, rows_out, cols))
         .lower(outs_struct)
+        .compile()
+    )
+    with _STAGE_LOCK:
+        return _STAGE_CACHE.setdefault(key, exe)
+
+
+def _pieces_exe(rows_out: int, cols: int, w: int):
+    """Compiled SWAR half of a split unpack (ops/xor_opt.py)."""
+    import jax
+
+    key = ("pieces", rows_out, cols, w)
+    with _STAGE_LOCK:
+        hit = _STAGE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    outs_struct = tuple([_plane_struct(cols)] * (rows_out * w))
+    exe = (
+        jax.jit(lambda os_: _pieces_stage(os_, w, rows_out))
+        .lower(outs_struct)
+        .compile()
+    )
+    with _STAGE_LOCK:
+        return _STAGE_CACHE.setdefault(key, exe)
+
+
+def _assemble_exe(rows_out: int, cols: int, w: int):
+    """Compiled assembly half of a split unpack (ops/xor_opt.py)."""
+    import jax
+
+    key = ("assemble", rows_out, cols, w)
+    with _STAGE_LOCK:
+        hit = _STAGE_CACHE.get(key)
+    if hit is not None:
+        return hit
+    pieces_struct = tuple([_plane_struct(cols)] * (rows_out * w))
+    exe = (
+        jax.jit(lambda ps: _assemble_stage(ps, w, rows_out, cols))
+        .lower(pieces_struct)
         .compile()
     )
     with _STAGE_LOCK:
@@ -885,11 +1007,14 @@ class XorPipeline:
 
     __slots__ = (
         "schedule", "k", "cols", "dtype", "compile_seconds",
-        "cost_analysis", "calls", "_pack", "_chain", "_unpack",
+        "cost_analysis", "calls", "opt", "_pack", "_chain", "_unpack",
+        "_pieces", "_assemble",
     )
 
     def __init__(self, schedule: XorSchedule, k: int, cols: int, dtype):
         import jax
+
+        from . import xor_opt as _xopt
 
         if cols % _COL_ALIGN:
             raise ValueError(
@@ -909,21 +1034,61 @@ class XorPipeline:
         # column: cols/32 packed uint32 words for BOTH widths (w=16
         # splits into lo/hi byte streams first, doubling the plane
         # count, not their size).
+        #
+        # The optimizer pass (ops/xor_opt.py, RS_XOR_OPT) rewrites the
+        # EMITTED program only: ``schedule`` stays the canonical stored
+        # form, ``emit`` is what the chain executable is traced from.
+        # Outputs are byte-identical either way.
+        emit = schedule
+        n_planes = (
+            schedule.n_inputs + len(schedule.pair_ops)
+            + len(schedule.rows)
+        )
+        nw = cols // _COL_ALIGN
+        if _xopt.opt_enabled():
+            pair_ops, rows, moved, groups = _xopt.optimize_program(
+                schedule.pair_ops, schedule.rows, schedule.n_inputs
+            )
+            emit = replace(schedule, pair_ops=pair_ops, rows=rows)
+            tile, n_tiles, ws = _xopt.choose_tile(n_planes, nw)
+            self.opt = _xopt.OptStats(
+                enabled=True, nodes_moved=moved, term_groups=groups,
+                tile_words=tile, n_tiles=n_tiles,
+                est_working_set_bytes=ws,
+                split_unpack=_xopt.split_unpack(nw),
+            )
+        else:
+            self.opt = _xopt.disabled_stats()
         self._pack = _pack_exe(k, cols, self.dtype, w)
         nodes_struct = tuple([_plane_struct(cols)] * (k * w))
-        self._chain = (
-            jax.jit(lambda ns: _chain_stage(ns, schedule))
-            .lower(nodes_struct).compile()
+        tile = self.opt.tile_words
+        chain_fn = (
+            (lambda ns: _tiled_chain_stage(ns, emit, tile)) if tile
+            else (lambda ns: _chain_stage(ns, emit))
         )
-        self._unpack = _unpack_exe(schedule.rows_out, cols, w)
+        self._chain = (
+            jax.jit(chain_fn).lower(nodes_struct).compile()
+        )
+        if self.opt.split_unpack:
+            self._unpack = None
+            self._pieces = _pieces_exe(schedule.rows_out, cols, w)
+            self._assemble = _assemble_exe(schedule.rows_out, cols, w)
+        else:
+            self._unpack = _unpack_exe(schedule.rows_out, cols, w)
+            self._pieces = self._assemble = None
         self.compile_seconds = time.perf_counter() - t0
         self.cost_analysis = self._merged_cost()
 
     def _merged_cost(self):
         from ..obs.attrib import extract_cost_analysis
 
+        stages = (
+            (self._pack, self._chain, self._unpack)
+            if self._unpack is not None
+            else (self._pack, self._chain, self._pieces, self._assemble)
+        )
         total: dict = {}
-        for exe in (self._pack, self._chain, self._unpack):
+        for exe in stages:
             ca = extract_cost_analysis(exe)
             if not ca:
                 return None
@@ -955,7 +1120,10 @@ class XorPipeline:
             # re-packs after a located correction drops its handle.
             _count_pack_reuse("packed")
             planes = _observed_pack(self._pack, B)
-        return self._unpack(self._chain(planes))
+        outs = self._chain(planes)
+        if self._unpack is not None:
+            return self._unpack(outs)
+        return self._assemble(self._pieces(outs))
 
     def describe(self) -> dict:
         s = self.schedule
@@ -971,6 +1139,7 @@ class XorPipeline:
             "xors": s.xors,
             "calls": self.calls,
             "compile_seconds": round(self.compile_seconds, 6),
+            "opt": self.opt.as_dict(),
         }
 
 
@@ -982,9 +1151,18 @@ def get_pipeline(A, B_shape, B_dtype, w: int) -> XorPipeline:
     """Build-or-fetch the compiled pipeline for concrete coefficients
     ``A`` and a (k, cols) operand class.  ``cols`` must be 32-aligned
     (use :func:`padded_cols`)."""
+    from . import xor_opt as _xopt
+
     schedule = build_schedule(A, w)
     k, cols = B_shape
-    key = (schedule.digest, schedule.cse, k, cols, np.dtype(B_dtype).str)
+    # The optimizer fingerprint keys the pipeline too: RS_XOR_OPT (and
+    # its tile knobs) change the EMITTED executables, so variants built
+    # under different settings must never share a slot (the A/B tool
+    # toggles the env between calls and expects both to stay cached).
+    key = (
+        schedule.digest, schedule.cse, k, cols,
+        np.dtype(B_dtype).str, _xopt.env_fingerprint(),
+    )
     with _PIPELINE_LOCK:
         pipe = _PIPELINE_CACHE.get(key)
         if pipe is None:
@@ -1010,6 +1188,21 @@ def clear_pipeline_cache() -> None:
     with _SCHEDULE_LOCK:
         _SCHEDULE_CACHE.clear()
     _reset_store_index()
+    # Dependent caches (ring pipelines share the stage cache just
+    # cleared, so they must drop with it — registered, not imported, to
+    # keep this module free of a ring dependency).
+    for hook in list(_CLEAR_HOOKS):
+        hook()
+
+
+_CLEAR_HOOKS: list = []
+
+
+def register_clear_hook(fn) -> None:
+    """Run ``fn`` on every :func:`clear_pipeline_cache` (ring_gemm uses
+    this so its pipelines — which share the stage cache — drop too)."""
+    if fn not in _CLEAR_HOOKS:
+        _CLEAR_HOOKS.append(fn)
 
 
 def pipeline_stats() -> list[dict]:

@@ -1,0 +1,245 @@
+"""XOR/ring schedule-optimizer pass — ``RS_XOR_OPT`` (docs/XOR.md).
+
+Post-CSE rewriting of the emitted XOR chains, in the spirit of the
+XOR-EC program-optimization literature (arXiv 2108.02692): the Paar
+pass minimises the XOR *count*; this pass optimises the *memory
+behaviour* of the straight-line program the count is spent in.  Three
+transforms, all semantics-preserving (XOR is associative/commutative;
+only emission order and blocking change — outputs are byte-identical
+with the pass on or off, which CI asserts):
+
+* **Topological reordering** — CSE pair nodes are re-emitted *demand
+  driven*: each node right before its first consumer (dependencies
+  first), instead of the Paar discovery order.  That minimises the
+  def-to-first-use distance, so a node's value is still cache-hot when
+  the chain first reads it.  ``nodes_moved`` counts repositioned nodes.
+* **Term grouping** — within each output row the XOR terms are grouped
+  by memory access pattern: CSE nodes first (most recently produced
+  first — the hottest lines), then raw input planes in ascending plane
+  order (one contiguous walk of the packed plane block).
+* **Region tiling** — the chain executable walks the packed planes in
+  contiguous column blocks sized so the whole live set (input planes +
+  CSE nodes + output accumulators) of one block fits the cache budget:
+  a ``lax.scan`` over column tiles of the plane vectors, slicing every
+  input plane and updating every output plane per step.  On the bench
+  box this moves the CSE-node traffic from L3 into L2 (measured 7.6 ms
+  -> 4.5 ms for the bench chain).  The pack/unpack stages stay whole —
+  they are compute-bound layout transforms that touch each word once.
+
+The pass also decides **unpack splitting** (a grouping decision at the
+stage level): XLA CPU fuses the unpack's SWAR transform into its final
+``concatenate``, which was measured to re-run the transform per
+concatenate operand (15.7 ms for an 8 MiB output where the transform
+alone costs 2.5 ms).  For large outputs the optimizer emits the SWAR
+pieces and the concatenate as two executables (4.2 ms total); small
+outputs keep the single executable — an extra dispatch would cost more
+than the fusion pathology.
+
+Env knobs (read at pipeline compile time; the pipeline cache key
+carries the resolved fingerprint, so toggling mid-process compiles a
+separate variant instead of poisoning the cache):
+
+* ``RS_XOR_OPT=0`` — disable the whole pass (legacy emission).
+* ``RS_XOR_TILE`` — force the chain tile width in packed words
+  (``0`` disables tiling only; unset = auto from the cache budget).
+* ``RS_XOR_TILE_BUDGET`` — cache budget in bytes for the auto tile
+  choice (default 2 MiB — an L2 of the boxes this was tuned on).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+__all__ = [
+    "OptStats", "opt_enabled", "tile_override", "tile_budget_bytes",
+    "reorder_pairs", "group_row_terms", "choose_tile",
+    "optimize_program", "split_unpack", "env_fingerprint",
+    "UNPACK_SPLIT_MIN_PLANE_BYTES",
+]
+
+# Tile bounds (packed uint32 words). 256 words = 1 KiB per plane slice —
+# below that the per-tile slice/update overhead beats any locality win.
+_MIN_TILE = 256
+_MAX_TILE = 1 << 20
+
+# Unpack splitting pays one extra dispatch; worth it only when the
+# fused-concatenate pathology costs more. 64 KiB planes (~512 K symbol
+# columns at w=8) was comfortably past break-even on the bench box.
+UNPACK_SPLIT_MIN_PLANE_BYTES = 65536
+
+
+def opt_enabled() -> bool:
+    """Whether the optimizer pass runs (``RS_XOR_OPT``, default on)."""
+    return os.environ.get("RS_XOR_OPT", "1").lower() not in (
+        "0", "false", "off", "no"
+    )
+
+
+def tile_override() -> int | None:
+    """``RS_XOR_TILE`` as words; ``0`` = force tiling off; None = auto."""
+    v = os.environ.get("RS_XOR_TILE")
+    if not v:
+        return None
+    try:
+        n = int(v)
+    except ValueError:
+        return None
+    return max(0, n)
+
+
+def tile_budget_bytes() -> int:
+    """Cache budget for the auto tile choice (``RS_XOR_TILE_BUDGET``)."""
+    try:
+        v = int(os.environ.get("RS_XOR_TILE_BUDGET", str(2 << 20)))
+        return v if v > 0 else (2 << 20)
+    except ValueError:
+        return 2 << 20
+
+
+def env_fingerprint() -> tuple:
+    """Resolved knob state, for pipeline cache keys: two pipelines built
+    under different optimizer settings must never share a cache slot."""
+    return (opt_enabled(), tile_override(), tile_budget_bytes())
+
+
+@dataclass(frozen=True)
+class OptStats:
+    """What the pass did to one pipeline (plan.describe / rs doctor)."""
+
+    enabled: bool
+    nodes_moved: int
+    term_groups: int
+    tile_words: int     # 0 = chain not tiled
+    n_tiles: int        # 1 = single whole-width pass
+    est_working_set_bytes: int
+    split_unpack: bool
+
+    def as_dict(self) -> dict:
+        return {
+            "enabled": self.enabled,
+            "nodes_moved": self.nodes_moved,
+            "term_groups": self.term_groups,
+            "tile_words": self.tile_words,
+            "n_tiles": self.n_tiles,
+            "est_working_set_bytes": self.est_working_set_bytes,
+            "split_unpack": self.split_unpack,
+        }
+
+
+_DISABLED = OptStats(
+    enabled=False, nodes_moved=0, term_groups=0, tile_words=0,
+    n_tiles=1, est_working_set_bytes=0, split_unpack=False,
+)
+
+
+def reorder_pairs(pair_ops, rows, n_inputs: int):
+    """Demand-driven topological reordering of the CSE pair nodes.
+
+    Walks the output rows in order; before a row is emitted, every
+    not-yet-emitted pair node it (transitively) needs is emitted,
+    dependencies first.  Pure permutation + index remap — the node
+    DAG and every XOR term set are preserved exactly.
+
+    Returns ``(pair_ops, rows, nodes_moved)`` with node indices
+    remapped to the new order.
+    """
+    n_pairs = len(pair_ops)
+    if not n_pairs:
+        return tuple(pair_ops), tuple(tuple(r) for r in rows), 0
+    emitted: dict[int, int] = {}  # old node idx -> new node idx
+    new_pairs: list[tuple[int, int]] = []
+
+    def emit(old: int) -> int:
+        if old < n_inputs:
+            return old
+        hit = emitted.get(old)
+        if hit is not None:
+            return hit
+        a, b = pair_ops[old - n_inputs]
+        na, nb = emit(a), emit(b)
+        new = n_inputs + len(new_pairs)
+        new_pairs.append((na, nb))
+        emitted[old] = new
+        return new
+
+    new_rows = tuple(
+        tuple(emit(t) for t in r) for r in rows
+    )
+    # Any pair never reachable from a row (paar never builds one, but a
+    # stored schedule could) is appended so node counts stay identical.
+    for old in range(n_inputs, n_inputs + n_pairs):
+        emit(old)
+    moved = sum(
+        1 for old, new in emitted.items()
+        if pair_ops[old - n_inputs] != new_pairs[new - n_inputs]
+        or old != new
+    )
+    return tuple(new_pairs), new_rows, moved
+
+
+def group_row_terms(pair_ops, rows, n_inputs: int):
+    """Group each row's terms by access pattern: CSE nodes first (newest
+    first — still hot), then input planes ascending (contiguous walk).
+
+    Returns ``(rows, term_groups)`` — term_groups counts the contiguous
+    access groups across all rows (≤ 2 per row).
+    """
+    groups = 0
+    out = []
+    for r in rows:
+        nodes = sorted((t for t in r if t >= n_inputs), reverse=True)
+        inputs = sorted(t for t in r if t < n_inputs)
+        groups += (1 if nodes else 0) + (1 if inputs else 0)
+        out.append(tuple(nodes + inputs))
+    return tuple(out), groups
+
+
+def choose_tile(n_planes: int, nw: int, *, itemsize: int = 4):
+    """Pick the chain tile width for ``n_planes`` live plane vectors of
+    ``nw`` packed words each.
+
+    Largest power-of-two ``T`` whose live set ``n_planes * T * itemsize``
+    fits the budget, clamped to ``[_MIN_TILE, _MAX_TILE]``; tiling is
+    only worth a scan when it yields at least two full tiles.  Returns
+    ``(tile_words, n_tiles, est_working_set_bytes)`` — ``(0, 1, ws)``
+    means "run the chain whole" (est is then the full-width live set).
+    """
+    ov = tile_override()
+    if ov == 0:
+        return 0, 1, n_planes * nw * itemsize
+    if ov:
+        t = min(ov, nw)
+        if nw // t < 2:
+            return 0, 1, n_planes * nw * itemsize
+        return t, -(-nw // t), n_planes * t * itemsize
+    budget = tile_budget_bytes()
+    t = _MIN_TILE
+    while (
+        t * 2 <= _MAX_TILE
+        and n_planes * (t * 2) * itemsize <= budget
+    ):
+        t *= 2
+    if n_planes * t * itemsize > budget or nw // t < 2:
+        # Budget unreachable even at the floor, or the operand is too
+        # narrow to cut twice — whole-width is cheaper than a scan.
+        return 0, 1, n_planes * nw * itemsize
+    return t, -(-nw // t), n_planes * t * itemsize
+
+
+def optimize_program(pair_ops, rows, n_inputs: int):
+    """Reorder + group one (pair_ops, rows) straight-line XOR program.
+    Returns ``(pair_ops, rows, nodes_moved, term_groups)``."""
+    pair_ops, rows, moved = reorder_pairs(pair_ops, rows, n_inputs)
+    rows, groups = group_row_terms(pair_ops, rows, n_inputs)
+    return pair_ops, rows, moved, groups
+
+
+def split_unpack(plane_words: int, *, itemsize: int = 4) -> bool:
+    """Whether the unpack stage should split SWAR pieces and assembly
+    into two executables (see module docstring)."""
+    return plane_words * itemsize >= UNPACK_SPLIT_MIN_PLANE_BYTES
+
+
+def disabled_stats() -> OptStats:
+    return _DISABLED

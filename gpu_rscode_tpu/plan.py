@@ -272,6 +272,23 @@ class ExecutionPlan:
         propagate to the dispatch site, where the codec's pallas guard can
         demote exactly like an eager failure."""
         w, strategy = self.w, self.strategy
+        if strategy == "ring":
+            # Same contract as the xor branch below, with the ring
+            # three-stage pipeline (ops/ring_gemm.py) as the composite.
+            from .ops import ring_gemm as _rg
+
+            t0 = time.perf_counter()
+            pipe = _rg.get_ring_pipeline(np.asarray(A), B.shape, B.dtype, w)
+            dt = time.perf_counter() - t0
+            self.compile_seconds += dt
+            if self.cost_analysis is None:
+                self.cost_analysis = pipe.cost_analysis
+            self.xor_stats = pipe.describe()
+            _metrics.histogram(
+                "rs_plan_compile_seconds",
+                "wall seconds spent in AOT lower+compile per plan variant",
+            ).labels(strategy=strategy).observe(dt)
+            return pipe
         if strategy == "xor":
             # Digest-keyed composite pipeline (ops/xor_gemm.py): three
             # stage executables whose XOR schedule is baked from the
@@ -375,8 +392,9 @@ class ExecutionPlan:
         }
         if self.xor_stats is not None:
             # Schedule economy for `rs doctor`: terms before/after CSE
-            # and the matrix digest this plan is keyed by.
-            out["xor"] = self.xor_stats
+            # and the matrix digest this plan is keyed by (keyed by the
+            # lowering that produced it — "xor" or "ring").
+            out[self.strategy] = self.xor_stats
         return out
 
 
@@ -534,9 +552,9 @@ def dispatch(
         str(np.dtype(B.dtype)),
         mesh_fingerprint(None),
     )
-    if strategy == "xor":
-        # The XOR schedule is a function of the coefficient VALUES, so
-        # the plan key carries the matrix digest (one compiled schedule
+    if strategy in ("xor", "ring"):
+        # The XOR/ring schedule is a function of the coefficient VALUES,
+        # so the plan key carries the matrix digest (one compiled schedule
         # per matrix, shared by every dispatch — docs/XOR.md); the
         # bucket additionally rounds up to the pipeline's 32-symbol
         # pack alignment (ragged caps only — ladder buckets are already
@@ -565,8 +583,11 @@ def dispatch(
         # decode/repair).  Encode's p < k dispatch would just compile a
         # donate variant that warns 'donated buffers were not usable' and
         # aliases nothing — drop the request instead.  The xor pipeline
-        # never donates: its stage split owns the intermediate planes.
-        can_alias = A.shape[0] == B.shape[0] and strategy != "xor"
+        # never donates: its stage split owns the intermediate planes
+        # (nor does ring, which shares the split).
+        can_alias = A.shape[0] == B.shape[0] and strategy not in (
+            "xor", "ring"
+        )
         out = plan.run(A, B, donate and can_alias and _donation_allowed())
     return out if bucket == m else out[:, :m]
 

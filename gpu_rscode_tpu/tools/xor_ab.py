@@ -14,7 +14,9 @@ segment loop, so the warm-streak number is the production-representative
 one for every arm (the first run just flushes the other arm's cache and
 allocator state).  Every strategy's output is verified bit-identical
 against the NumPy GF oracle on a leading slab before any timing counts.
-The capture row records per-strategy GB/s plus the xor/table speedup;
+The capture row records per-strategy GB/s plus the xor/table speedup,
+the optimizer off/on delta (the default arm list carries ``xor_noopt``
+— xor with ``RS_XOR_OPT=0`` — next to ``xor``) and the ring/xor delta;
 ``bench_captures/xor_ab_*.jsonl`` joins the BENCH trajectory via the
 shared ``capture_header``.
 
@@ -41,15 +43,43 @@ import os
 import sys
 import time
 
-_DEFAULT_STRATEGIES = "xor,table"
+_DEFAULT_STRATEGIES = "xor,xor_noopt,ring,table"
 _VERIFY_COLS = 4096
 
 
+def _with_opt_off(fn):
+    """Run ``fn`` with the schedule-optimizer pass disabled.  Cheap to
+    toggle per call: the xor/ring pipeline cache keys on the resolved
+    optimizer fingerprint, so both variants stay compiled side by side
+    and the flip selects between warm pipelines."""
+
+    def run(b):
+        prev = os.environ.get("RS_XOR_OPT")
+        os.environ["RS_XOR_OPT"] = "0"
+        try:
+            return fn(b)
+        finally:
+            if prev is None:
+                os.environ.pop("RS_XOR_OPT", None)
+            else:
+                os.environ["RS_XOR_OPT"] = prev
+
+    return run
+
+
 def _runner(name: str, A, Bd, w: int):
+    # A trailing "_noopt" runs the base strategy with RS_XOR_OPT=0 —
+    # the optimizer off/on delta measured inside ONE capture.
+    if name.endswith("_noopt"):
+        return _with_opt_off(_runner(name[: -len("_noopt")], A, Bd, w))
     if name == "xor":
         from ..ops.xor_gemm import gf_matmul_xor
 
         return lambda b: gf_matmul_xor(A, b, w)
+    if name == "ring":
+        from ..ops.ring_gemm import gf_matmul_ring
+
+        return lambda b: gf_matmul_ring(A, b, w)
     if name == "pallas":
         from ..ops.pallas_gemm import gf_matmul_pallas
 
@@ -123,6 +153,14 @@ def run_ab(
         round(gbps["xor"] / gbps["table"], 3)
         if gbps.get("xor") and gbps.get("table") else None
     )
+    opt_speedup = (
+        round(gbps["xor"] / gbps["xor_noopt"], 3)
+        if gbps.get("xor") and gbps.get("xor_noopt") else None
+    )
+    ring_over_xor = (
+        round(gbps["ring"] / gbps["xor"], 3)
+        if gbps.get("ring") and gbps.get("xor") else None
+    )
     row = {
         "kind": "xor_ab",
         "op": "encode",
@@ -135,13 +173,17 @@ def run_ab(
             name: [round(x, 6) for x in ws] for name, ws in walls.items()
         },
         "xor_over_table": speedup,
+        "opt_speedup": opt_speedup,
+        "ring_over_xor": ring_over_xor,
     }
     if not quiet:
         detail = "  ".join(f"{n}={g} GB/s" for n, g in gbps.items())
         print(
             f"xor_ab: k={k} p={p} w={w} {data_bytes >> 20}MiB stripe: "
             f"{detail}"
-            + (f"  -> xor/table {speedup}x" if speedup else ""),
+            + (f"  -> xor/table {speedup}x" if speedup else "")
+            + (f"  opt on/off {opt_speedup}x" if opt_speedup else "")
+            + (f"  ring/xor {ring_over_xor}x" if ring_over_xor else ""),
             file=sys.stderr,
         )
     return [row]
@@ -334,7 +376,8 @@ def main(argv=None) -> int:
     ap.add_argument("--w", type=int, default=8, choices=(8, 16))
     ap.add_argument("--strategies", default=_DEFAULT_STRATEGIES,
                     help=f"comma list (default {_DEFAULT_STRATEGIES}; "
-                    "also: bitplane, pallas, native)")
+                    "also: bitplane, pallas, native; a _noopt suffix "
+                    "runs that strategy with RS_XOR_OPT=0)")
     ap.add_argument("--trials", type=int, default=5)
     ap.add_argument("--capture", default=None,
                     help="capture JSONL path (default bench_captures/"

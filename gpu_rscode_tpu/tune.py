@@ -52,7 +52,9 @@ __all__ = [
 ]
 
 # Every strategy the codec accepts ("auto" resolves to one of the rest).
-VALID_STRATEGIES = ("auto", "bitplane", "table", "pallas", "xor", "cpu")
+VALID_STRATEGIES = (
+    "auto", "bitplane", "table", "pallas", "xor", "ring", "cpu"
+)
 
 _DECISIONS: dict[tuple, dict] = {}
 _LOCK = threading.Lock()
@@ -97,6 +99,11 @@ def candidate_strategies(w: int = 8, *, include_native: bool = True):
         cands = ["pallas", "bitplane", "xor", "table"]
     else:
         cands = ["bitplane", "xor", "table"]
+    if w == 8:
+        # The ring lowering's p/w plane expansion is 2.125x at w=8 but
+        # 16x at w=16 (docs/XOR.md "Ring lowering") — w=16 ring is a
+        # correctness rung, never an autotune candidate.
+        cands.insert(cands.index("xor") + 1, "ring")
     if include_native and w == 8:
         from . import native
 
@@ -225,6 +232,12 @@ def _measure_one(strategy: str, A, B, w: int) -> float:
 
         def run():
             return gf_matmul_xor(A, B, w)
+
+    elif strategy == "ring":
+        from .ops.ring_gemm import gf_matmul_ring
+
+        def run():
+            return gf_matmul_ring(A, B, w)
 
     elif strategy == "pallas":
         from .ops.pallas_gemm import gf_matmul_pallas

@@ -149,7 +149,7 @@ def test_seeded_random_erasures_all_strategies_roundtrip():
         dec = codec.decode_matrix(surv)
         want = np.asarray(codec.decode(dec, code[surv]))
         np.testing.assert_array_equal(want, natives)
-        for strategy in ("bitplane", "table", "xor"):
+        for strategy in ("bitplane", "table", "xor", "ring"):
             got = np.asarray(
                 gf_matmul(dec, code[surv], strategy=strategy)
             )
@@ -301,13 +301,15 @@ def test_all_strategies_agree_on_full_gf8_mul_table():
         got = np.asarray(gf_matmul(a, b, w=8, strategy=strategy))
         np.testing.assert_array_equal(got, want, err_msg=strategy)
     np.testing.assert_array_equal(native.gemm(a, b), want)
-    # The xor strategy's exhaustive pass lives in
-    # test_xor_strategy_full_gf8_mul_table_exhaustive (slow: its
-    # value-baked schedules make a 256-row k=1 GEMM a 256-schedule
-    # compile); here it covers a sampled 32-value slab of the table.
+    # The xor/ring strategies' exhaustive passes live in
+    # test_{xor,ring}_strategy_full_gf8_mul_table_exhaustive (slow:
+    # their value-baked schedules make a 256-row k=1 GEMM a
+    # 256-schedule compile); here each covers a sampled 32-value slab.
     rows = np.arange(37, 69, dtype=np.uint8).reshape(32, 1)
     got = np.asarray(gf_matmul(rows, b, w=8, strategy="xor"))
     np.testing.assert_array_equal(got, want[37:69], err_msg="xor slab")
+    got = np.asarray(gf_matmul(rows, b, w=8, strategy="ring"))
+    np.testing.assert_array_equal(got, want[37:69], err_msg="ring slab")
 
 
 @pytest.mark.slow
@@ -332,6 +334,27 @@ def test_xor_strategy_full_gf8_mul_table_exhaustive():
         )
 
 
+@pytest.mark.slow
+def test_ring_strategy_full_gf8_mul_table_exhaustive():
+    """The ring strategy computes the FULL 256x256 GF(2^8) product table
+    bit-identically — every coefficient's minimum-weight ring lift is
+    exercised (same k=1 slab trick as the xor pass above).  Run by the
+    CI xor-smoke job's ring leg."""
+    from gpu_rscode_tpu.ops.gemm import gf_matmul
+
+    b = np.arange(256, dtype=np.uint8).reshape(1, 256)
+    want = GF.mul(
+        np.arange(256, dtype=np.int64)[:, None],
+        np.arange(256, dtype=np.int64)[None, :],
+    ).astype(np.uint8)
+    for lo in range(0, 256, 32):
+        a = np.arange(lo, lo + 32, dtype=np.uint8).reshape(32, 1)
+        got = np.asarray(gf_matmul(a, b, w=8, strategy="ring"))
+        np.testing.assert_array_equal(
+            got, want[lo:lo + 32], err_msg=f"ring rows {lo}..{lo + 31}"
+        )
+
+
 def test_strategies_agree_sampled_gf16():
     """Sampled GF(2^16) GEMMs: table, bitplane, pallas and the
     XOR-lowered path agree with the host oracle (native is w=8-only by
@@ -347,7 +370,7 @@ def test_strategies_agree_sampled_gf16():
         A = rng.integers(0, 1 << 16, size=(p, k), dtype=np.uint16)
         B = rng.integers(0, 1 << 16, size=(k, m), dtype=np.uint16)
         want = gf16.matmul(A, B)
-        for strategy in ("table", "bitplane", "pallas", "xor"):
+        for strategy in ("table", "bitplane", "pallas", "xor", "ring"):
             got = np.asarray(gf_matmul(A, B, w=16, strategy=strategy))
             np.testing.assert_array_equal(
                 got, want, err_msg=f"{strategy} ({p},{k},{m})"
@@ -379,7 +402,7 @@ def test_encode_linearity_across_strategies():
             E = rng.integers(0, hi, size=(p, k)).astype(dtype)
             a = rng.integers(0, hi, size=(k, m)).astype(dtype)
             b = rng.integers(0, hi, size=(k, m)).astype(dtype)
-            for strategy in ("table", "bitplane", "pallas", "xor"):
+            for strategy in ("table", "bitplane", "pallas", "xor", "ring"):
                 lhs = np.asarray(gf_matmul(E, a ^ b, w=w, strategy=strategy))
                 rhs = np.asarray(
                     gf_matmul(E, a, w=w, strategy=strategy)
@@ -420,7 +443,7 @@ def test_delta_parity_identity_across_strategies():
             parity_old = np.asarray(codec.encode(old))
             parity_new = np.asarray(codec.encode(new))
             delta = old ^ new
-            for strategy in ("table", "bitplane", "pallas", "xor"):
+            for strategy in ("table", "bitplane", "pallas", "xor", "ring"):
                 pd = np.asarray(gf_matmul(E, delta, w=w, strategy=strategy))
                 np.testing.assert_array_equal(
                     parity_old ^ pd, parity_new,

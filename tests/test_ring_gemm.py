@@ -1,0 +1,315 @@
+"""``strategy="ring"`` (ops/ring_gemm.py, docs/XOR.md "Ring lowering"):
+embedding math, oracle byte-equivalence, pipeline plumbing (packed
+operands, plan dispatch, mesh rejection), and the ring schedule's
+persistent-store contract."""
+
+import json
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import plan, tune
+from gpu_rscode_tpu.codec import RSCodec
+from gpu_rscode_tpu.obs import runlog
+from gpu_rscode_tpu.ops import ring_gemm as rg
+from gpu_rscode_tpu.ops import xor_gemm as xg
+from gpu_rscode_tpu.ops.gf import get_field
+
+GF8 = get_field(8)
+
+
+@pytest.fixture()
+def store(tmp_path, monkeypatch):
+    p = str(tmp_path / "store.jsonl")
+    monkeypatch.setenv("RS_SCHEDULE_STORE", p)
+    plan.PLAN_CACHE.clear()
+    tune.clear_decisions()
+    yield p
+    plan.PLAN_CACHE.clear()
+    tune.clear_decisions()
+
+
+def _mat(rows=4, cols=6, seed=0, w=8):
+    gf = get_field(w)
+    rng = np.random.default_rng(seed)
+    return rng.integers(1, gf.size, size=(rows, cols)).astype(gf.dtype)
+
+
+# ----- embedding math --------------------------------------------------------
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_ring_embedding_is_a_homomorphism(w):
+    """Psi's columns are the powers of an order-p element g, so column
+    arithmetic IS ring arithmetic: psi(x^a) * psi(x^b) == psi(x^(a+b))
+    and the w leading columns form a basis (M inverts them)."""
+    gf = get_field(w)
+    ctx = rg._ctx(w)
+    p = ctx.p
+
+    def col_val(t):
+        return sum(int(ctx.psi[b, t]) << b for b in range(w))
+
+    # g has order exactly p.
+    vals = [col_val(t) for t in range(p)]
+    assert vals[0] == 1 and len(set(vals)) == p
+    got = int(
+        np.asarray(
+            gf.mul(
+                np.array([vals[3]], gf.dtype),
+                np.array([vals[5]], gf.dtype),
+            )
+        )[0]
+    )
+    assert got == vals[8]
+    # M . Psi[:, :w] == I over GF(2).
+    eye = (ctx.m @ ctx.psi[:, :w]) % 2
+    np.testing.assert_array_equal(eye, np.eye(w, dtype=np.uint8))
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_ring_lifts_are_preimages(w):
+    """Every coefficient's lift satisfies Psi . lift == bits(a) — the
+    lift really is a preimage under the ring homomorphism."""
+    ctx = rg._ctx(w)
+    rng = np.random.default_rng(2)
+    sample = (
+        range(256) if w == 8
+        else [int(x) for x in rng.integers(1, 1 << 16, 64)]
+    )
+    for a in sample:
+        lift = ctx.lift(a)
+        bits = (ctx.psi @ lift) % 2
+        want = np.array([(a >> b) & 1 for b in range(w)], np.uint8)
+        np.testing.assert_array_equal(bits, want, err_msg=f"a={a}")
+        if a:
+            assert lift.sum() >= 1
+
+
+def test_ring_params_surface():
+    p8 = rg.ring_params(8)
+    assert (p8["p"], p8["w"]) == (17, 8)
+    assert rg.ring_params(16)["p"] == 257
+
+
+# ----- oracle equivalence ----------------------------------------------------
+
+
+def test_ring_full_gf8_multiplier_slab():
+    """k=1 GEMM against an exhaustive multiplicand row: one slab of 32
+    coefficient values covers min-weight lifts of every weight class
+    (the full 256-value pass lives in test_property.py)."""
+    b = np.arange(256, dtype=np.uint8).reshape(1, 256)
+    a = np.arange(101, 133, dtype=np.uint8).reshape(32, 1)
+    want = GF8.matmul(a, b)
+    got = np.asarray(rg.gf_matmul_ring(a, b, 8))
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("w", [8, 16])
+def test_ring_matches_oracle_random_shapes(w):
+    gf = get_field(w)
+    rng = np.random.default_rng(w)
+    # Smaller shape bounds at w=16: schedule builds are the dominant cost
+    # (p=257 planes per symbol) and coverage does not improve with k.
+    trials, k_hi = (3, 7) if w == 8 else (2, 4)
+    for _ in range(trials):
+        p = int(rng.integers(1, 4 if w == 16 else 5))
+        k = int(rng.integers(1, k_hi))
+        m = int(rng.integers(1, 500))
+        A = rng.integers(0, gf.size, (p, k)).astype(gf.dtype)
+        B = rng.integers(0, gf.size, (k, m)).astype(gf.dtype)
+        got = np.asarray(rg.gf_matmul_ring(A, B, w))
+        np.testing.assert_array_equal(
+            got, gf.matmul(A, B), err_msg=f"w={w} ({p},{k},{m})"
+        )
+
+
+def test_ring_zero_rows_and_empty_operand():
+    A = np.zeros((3, 4), np.uint8)
+    B = np.arange(4 * 64, dtype=np.uint8).reshape(4, 64)
+    got = np.asarray(rg.gf_matmul_ring(A, B, 8))
+    np.testing.assert_array_equal(got, np.zeros((3, 64), np.uint8))
+    assert rg.gf_matmul_ring(A, B[:, :0], 8).shape == (3, 0)
+
+
+def test_ring_traced_coefficients_rejected():
+    import jax
+
+    B = np.zeros((2, 64), np.uint8)
+
+    @jax.jit
+    def bad(a):
+        return rg.gf_matmul_ring(a, B, 8)
+
+    with pytest.raises(TypeError, match="concrete"):
+        bad(np.ones((2, 2), np.uint8))
+
+
+def test_ring_traced_data_inlines():
+    import jax
+
+    A = _mat(seed=4)
+    B = _mat(rows=6, cols=96, seed=5)
+
+    got = np.asarray(jax.jit(lambda b: rg.gf_matmul_ring(A, b, 8))(B))
+    np.testing.assert_array_equal(got, GF8.matmul(A, B))
+
+
+# ----- codec / plan plumbing -------------------------------------------------
+
+
+def test_ring_codec_validation():
+    with pytest.raises(ValueError, match="GF\\(2\\^8\\) and GF\\(2\\^16\\)"):
+        RSCodec(4, 2, w=4, strategy="ring")
+    with pytest.raises(ValueError, match="single-device"):
+        RSCodec(4, 2, strategy="ring", mesh=object())
+    with pytest.raises(ValueError, match="ring"):
+        # the one actionable error enumerates ring among the choices
+        RSCodec(4, 2, strategy="rinng")
+
+
+def test_ring_codec_all_ops_match_table():
+    rng = np.random.default_rng(9)
+    c = RSCodec(6, 3, strategy="ring")
+    ct = RSCodec(6, 3, strategy="table")
+    data = rng.integers(0, 256, (6, 200)).astype(np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(c.encode(data)), np.asarray(ct.encode(data))
+    )
+    dm = rng.integers(0, 256, (6, 6)).astype(np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(c.decode(dm, data)), np.asarray(ct.decode(dm, data))
+    )
+    pm = np.asarray(c.parity_block)
+    np.testing.assert_array_equal(
+        np.asarray(c.update(pm, data)), np.asarray(ct.update(pm, data))
+    )
+    cm = rng.integers(0, 256, (3, 9)).astype(np.uint8)
+    chunks = rng.integers(0, 256, (9, 96)).astype(np.uint8)
+    np.testing.assert_array_equal(
+        np.asarray(c.syndrome(cm, chunks)),
+        np.asarray(ct.syndrome(cm, chunks)),
+    )
+
+
+def test_ring_packed_operand_through_plan():
+    rng = np.random.default_rng(10)
+    c = RSCodec(5, 2, strategy="ring")
+    data = rng.integers(0, 256, (5, 4096)).astype(np.uint8)
+    po = c.pack_operand(data)
+    assert isinstance(po, xg.PackedOperand)
+    got = np.asarray(c._matmul(np.asarray(c.parity_block), po))
+    want = GF8.matmul(np.asarray(c.parity_block), data)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_ring_pipeline_rejects_mismatched_packed_operand():
+    A = _mat(rows=2, cols=4, seed=6)
+    pipe = rg.get_ring_pipeline(A, (4, 1024), np.uint8, 8)
+    rng = np.random.default_rng(6)
+    other = xg.pack_operand(
+        rng.integers(0, 256, (4, 2048)).astype(np.uint8), 8
+    )
+    with pytest.raises(ValueError, match="does not match"):
+        pipe(A, other)
+
+
+def test_ring_in_autotune_candidates():
+    assert "ring" in tune.candidate_strategies(8)
+    # w=16's 16x plane expansion keeps ring correctness-only there.
+    assert "ring" not in tune.candidate_strategies(16)
+    assert "ring" in tune.VALID_STRATEGIES
+
+
+# ----- persistent store ------------------------------------------------------
+
+
+def test_ring_store_roundtrip(store):
+    A = _mat(seed=21)
+    before = rg.ring_store_stats()
+    s1 = rg.build_ring_schedule(A, 8)
+    d = {k: rg.ring_store_stats()[k] - before[k]
+         for k in ("hits", "misses", "stored", "corrupt", "built")}
+    assert d["built"] == 1 and d["stored"] == 1 and d["misses"] == 1
+    plan.PLAN_CACHE.clear()
+    before = rg.ring_store_stats()
+    s2 = rg.build_ring_schedule(A, 8)
+    d = {k: rg.ring_store_stats()[k] - before[k]
+         for k in ("hits", "misses", "stored", "corrupt", "built")}
+    assert d["hits"] == 1 and d["built"] == 0 and d["stored"] == 0
+    assert s2.stage_payloads == s1.stage_payloads
+    assert s2.s2_planes == s1.s2_planes
+    recs = [r for r in runlog.read_records(store)
+            if r.get("kind") == "rs_ring_schedule"]
+    assert len(recs) == 1
+    assert recs[0]["algo_version"] == rg._STORE_ALGO
+
+
+@pytest.mark.parametrize(
+    "tamper", ["algo_version", "out_of_range", "payload"]
+)
+def test_ring_store_corruption_recomputes(store, tamper):
+    A = _mat(seed=22)
+    s1 = rg.build_ring_schedule(A, 8)
+    recs = runlog.read_records(store)
+    rec = next(r for r in recs if r.get("kind") == "rs_ring_schedule")
+    if tamper == "algo_version":
+        # A pre-this-algorithm record whose payload digest still
+        # validates must be dropped on the version field alone.
+        rec["algo_version"] = rg._STORE_ALGO - 1
+    elif tamper == "out_of_range":
+        rec["s3_rows"] = [[999999]] + rec["s3_rows"][1:]
+    else:
+        rec["s1_rows"] = [sorted(set(rec["s1_rows"][0]) ^ {0, 1})] \
+            + rec["s1_rows"][1:]
+    with open(store, "w") as fp:
+        for r in recs:
+            fp.write(json.dumps(r) + "\n")
+    plan.PLAN_CACHE.clear()
+    before = rg.ring_store_stats()
+    s2 = rg.build_ring_schedule(A, 8)
+    d = {k: rg.ring_store_stats()[k] - before[k]
+         for k in ("corrupt", "built")}
+    assert d == {"corrupt": 1, "built": 1}
+    assert s2.stage_payloads == s1.stage_payloads
+    # the recompute re-stored: a third build loads clean
+    plan.PLAN_CACHE.clear()
+    before = rg.ring_store_stats()
+    rg.build_ring_schedule(A, 8)
+    d = {k: rg.ring_store_stats()[k] - before[k]
+         for k in ("hits", "built")}
+    assert d == {"hits": 1, "built": 0}
+
+
+def test_ring_cache_clear_rides_xor_clear(store):
+    A = _mat(seed=23)
+    rg.build_ring_schedule(A, 8)
+    assert rg.ring_schedule_stats()
+    xg.clear_pipeline_cache()  # the hook must clear ring too
+    assert not rg.ring_schedule_stats()
+    assert not rg.ring_pipeline_stats()
+
+
+def test_ring_schedule_max_terms_guard(monkeypatch):
+    monkeypatch.setenv("RS_XOR_MAX_TERMS", "50")
+    xg.clear_pipeline_cache()
+    with pytest.raises(ValueError, match="RS_XOR_MAX_TERMS"):
+        rg.build_ring_schedule(_mat(rows=6, cols=8, seed=24), 8)
+
+
+def test_ring_plan_describe_carries_ring_stats(store):
+    import gpu_rscode_tpu.plan as _plan
+
+    if not _plan.enabled():
+        pytest.skip("plan layer disabled in this environment")
+    c = RSCodec(4, 2, strategy="ring")
+    rng = np.random.default_rng(25)
+    data = rng.integers(0, 256, (4, 2048)).astype(np.uint8)
+    c.encode(data)
+    ring_descs = [
+        d for d in _plan.PLAN_CACHE.stats()["plans"] if d.get("ring")
+    ]
+    assert ring_descs, "ring plan must surface its schedule stats"
+    assert "opt" in ring_descs[0]["ring"]
+    assert ring_descs[0]["ring"]["p"] == 17

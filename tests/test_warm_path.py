@@ -149,6 +149,41 @@ def test_store_stats_shape(store):
             "built"} <= set(st)
 
 
+def test_pre_optimizer_store_record_recomputed_not_served(store):
+    """Algo-version regression (ISSUE 16): a store populated BEFORE the
+    schedule-optimizer landed carries ``algo: 1`` records without the
+    explicit ``algo_version`` field, and their payload digests still
+    validate (the digest never covered the algo fields).  Such a record
+    must take the corrupt-style drop + recompute + re-store path — never
+    be served on the strength of its checksum."""
+    A = _mat(seed=14)
+    s1 = xg.build_schedule(A, 8)
+    recs = runlog.read_records(store)
+    rec = next(r for r in recs if r.get("kind") == "rs_xor_schedule")
+    # Rewrite to the exact pre-PR record shape: old algo value, no
+    # algo_version field, payload digest untouched (it still validates).
+    rec["algo"] = 1
+    del rec["algo_version"]
+    with open(store, "w") as fp:
+        for r in recs:
+            fp.write(json.dumps(r) + "\n")
+    plan.PLAN_CACHE.clear()
+    before = xg.store_stats()
+    s2 = xg.build_schedule(A, 8)
+    d = _delta(xg.store_stats(), before)
+    assert d["corrupt"] == 1 and d["built"] == 1 and d["hits"] == 0
+    assert (s2.pair_ops, s2.rows) == (s1.pair_ops, s1.rows)
+    # The recompute re-stored a current-version record: next build loads.
+    plan.PLAN_CACHE.clear()
+    before = xg.store_stats()
+    xg.build_schedule(A, 8)
+    d = _delta(xg.store_stats(), before)
+    assert d["hits"] == 1 and d["built"] == 0
+    newest = [r for r in runlog.read_records(store)
+              if r.get("kind") == "rs_xor_schedule"]
+    assert newest[-1]["algo_version"] == xg._STORE_ALGO
+
+
 # ----- autotune ledger precedence --------------------------------------------
 
 
