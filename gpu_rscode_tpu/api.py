@@ -33,8 +33,8 @@ from collections import OrderedDict
 from contextlib import contextmanager, nullcontext
 
 from .codec import RSCodec
-from .obs import attrib as _obs_attrib, metrics as _obs_metrics, \
-    runlog as _obs_runlog, tracing as _obs_tracing
+from .obs import attrib as _obs_attrib, health as _obs_health, \
+    metrics as _obs_metrics, runlog as _obs_runlog, tracing as _obs_tracing
 from .parallel.io_executor import DrainExecutor, FleetPipeline
 from .parallel.pipeline import AsyncWindow, DeviceStagingRing, SegmentPrefetcher
 from .resilience import faults as _faults, retry as _retry
@@ -2139,14 +2139,21 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
         )
         healthy: list[int] = []
         bad: dict[int, str] = {}
+        # Per-index damage verdicts for the health plane (obs/health.py):
+        # one rs_damage "scan" event per scan, whose FULL state map (an
+        # empty one included — a clean scan clears prior damage) is the
+        # fleet model's scrub-freshness signal.
+        damage_states: dict[int, str] = {}
         for i in range(k + p):
             path = chunk_file_name(in_file, i)
             if not os.path.exists(path):
                 chunk_states.labels(state="missing").inc()
+                damage_states[i] = "missing"
                 continue
             if os.path.getsize(path) < chunk:
                 bad[i] = path  # present but truncated — damage, not loss
                 chunk_states.labels(state="truncated").inc()
+                damage_states[i] = "truncated"
                 continue
             if i in crcs:
                 try:
@@ -2157,6 +2164,7 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
                     # Shrank between the getsize above and this open.
                     bad[i] = path
                     chunk_states.labels(state="truncated").inc()
+                    damage_states[i] = "truncated"
                     continue
                 except OSError:
                     # Degraded read: a chunk that stays unreadable after
@@ -2165,10 +2173,12 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
                     # repair treats it like any other corrupt chunk.
                     bad[i] = path
                     chunk_states.labels(state="read_error").inc()
+                    damage_states[i] = "read_error"
                     continue
                 if chunk_crc32(mm, chunk, segment_bytes) != crcs[i]:
                     bad[i] = path
                     chunk_states.labels(state="crc_mismatch").inc()
+                    damage_states[i] = "crc_mismatch"
                     continue
             healthy.append(i)
             chunk_states.labels(state="healthy").inc()
@@ -2176,6 +2186,10 @@ def _scan_chunks(in_file: str, segment_bytes: int) -> _ChunkScan:
             "rs_scrub_archives_scanned_total", "archive health scans"
         ).labels(outcome="damaged" if bad or len(healthy) < k + p
                  else "clean").inc()
+        _obs_health.record_damage(
+            "scan", in_file, states=damage_states, k=k, p=p, w=w,
+            generation=meta.generation,
+        )
         return _ChunkScan(
             in_file, total_size, p, k, total_mat, w, crcs, chunk, healthy,
             bad, layout=meta.layout, generation=meta.generation,
@@ -2527,6 +2541,17 @@ def auto_decode_file(
             last = e
             if isinstance(e, ChunkIntegrityError):
                 excluded.update(e.bad_chunks)
+                # Survivors that failed AFTER the scan selected them
+                # (TOCTOU opens, mid-stream read errors) are damage the
+                # scan's state map missed — feed them to the health
+                # plane under their own event so the fleet model sees
+                # decode-discovered loss too.
+                _obs_health.record_damage(
+                    "decode_failure", in_file,
+                    chunks=sorted(e.bad_chunks),
+                    k=scan.k, p=scan.p, w=scan.w,
+                    generation=scan.generation,
+                )
             if attempt + 1 >= attempts:
                 # Escalation's final rung: the reselect loop is
                 # exhausted — survivors keep failing under the erasure
@@ -2980,7 +3005,7 @@ def repair_file(
             in_file, strategy=strategy, segment_bytes=segment_bytes,
             pipeline_depth=pipeline_depth, mesh=mesh,
             stripe_sharded=stripe_sharded, timer=timer,
-        ))
+        ), in_file)
     with timer.phase("scan chunks (io)"):
         scan = _scan_chunks(in_file, segment_bytes)
     targets = scan.unhealthy
@@ -2992,7 +3017,7 @@ def repair_file(
         # Still subject to the >=k-healthy contract (raises otherwise) so
         # repairability matches scan_file's decodable verdict: an archive
         # that cannot produce a valid k-chunk conf is not "repairable".
-        _select_decodable_subset(scan)
+        _repair_select_or_fail(scan)
         for t in targets:
             _write_empty_atomic(chunk_file_name(in_file, t))
         if scan.crcs:
@@ -3000,21 +3025,24 @@ def repair_file(
                 metadata_file_name(in_file),
                 {**scan.crcs, **{t: 0 for t in targets}},  # crc32(b"") == 0
             )
-        return _count_repair_outcome(targets)
+        return _count_repair_outcome(targets, in_file, scan)
     with timer.phase("invert matrix"):
-        chosen, inv = _select_decodable_subset(scan)
+        chosen, inv = _repair_select_or_fail(scan)
     return _count_repair_outcome(_repair_streamed(
         in_file, scan, chosen, inv, strategy=strategy,
         segment_bytes=segment_bytes, pipeline_depth=pipeline_depth,
         mesh=mesh, stripe_sharded=stripe_sharded, timer=timer,
-    ))
+    ), in_file, scan)
 
 
-def _count_repair_outcome(rebuilt: list[int]) -> list[int]:
+def _count_repair_outcome(rebuilt: list[int], in_file: str | None = None,
+                          scan: "_ChunkScan | None" = None) -> list[int]:
     """Count one archive's repair verdict (the scrub/repair loop's
     outcome series): ``rs_repair_outcomes_total{outcome}`` plus the
     rebuilt-chunk volume.  Identity on its argument so the return sites
-    stay one-liners."""
+    stay one-liners.  With ``in_file``, a non-empty rebuild also appends
+    one ``rs_damage`` "repair" event so the health plane clears the
+    rebuilt chunks from the archive's damage map."""
     _obs_metrics.counter(
         "rs_repair_outcomes_total", "archive repair outcomes"
     ).labels(outcome="rebuilt" if rebuilt else "healthy").inc()
@@ -3023,7 +3051,31 @@ def _count_repair_outcome(rebuilt: list[int]) -> list[int]:
             "rs_repair_chunks_rebuilt_total",
             "chunk files regenerated by repair",
         ).inc(len(rebuilt))
+        if in_file is not None:
+            _obs_health.record_damage(
+                "repair", in_file, chunks=rebuilt,
+                k=scan.k if scan else None, p=scan.p if scan else None,
+                w=scan.w if scan else None,
+                generation=scan.generation if scan else None,
+            )
     return rebuilt
+
+
+def _repair_select_or_fail(scan: "_ChunkScan"):
+    """Survivor-subset selection for a single-archive repair, recording
+    the failure to the health plane before it propagates: an archive
+    repair cannot fix is the strongest at-risk signal the fleet model
+    has (the repair-failure term in docs/HEALTH.md's risk formula)."""
+    try:
+        return _select_decodable_subset(scan)
+    except ValueError as e:
+        _obs_health.record_damage(
+            "repair_failed", scan.in_file,
+            k=scan.k, p=scan.p, w=scan.w, generation=scan.generation,
+            verdict="undecided" if isinstance(e, UndecidedSubsetError)
+            else "unrecoverable",
+        )
+        raise
 
 
 def _repair_streamed(
@@ -3506,6 +3558,12 @@ def repair_fleet(
         _obs_metrics.counter(
             "rs_repair_outcomes_total", "archive repair outcomes"
         ).labels(outcome="unrecoverable").inc(len(errors))
+        for f in sorted(errors):
+            s = scans[f]
+            _obs_health.record_damage(
+                "repair_failed", f, k=s.k, p=s.p, w=s.w,
+                generation=s.generation, verdict="unrecoverable",
+            )
         raise ValueError(
             "unrecoverable archives (nothing repaired): "
             + "; ".join(f"{f}: {msg}" for f, msg in sorted(errors.items()))
@@ -3534,7 +3592,7 @@ def repair_fleet(
                     pipeline_depth=pipeline_depth,
                     mesh=None, stripe_sharded=False, timer=timer,
                     fleet=pipe,
-                ))
+                ), f, s)
     return results
 
 
@@ -3575,10 +3633,16 @@ def update_file(
     """
     from .update import apply_update
 
-    return apply_update(
+    out = apply_update(
         file_name, at, data, src=src, strategy=strategy,
         segment_bytes=segment_bytes, timer=timer,
     )
+    # Generation moved past the last verified scrub: the health plane
+    # treats the archive as scrub-stale until it is re-scanned.
+    _obs_health.record_damage(
+        "update", file_name, generation=out.get("generation"),
+    )
+    return out
 
 
 @_observed_file_op("append")
@@ -3605,10 +3669,14 @@ def append_file(
     """
     from .update import apply_append
 
-    return apply_append(
+    out = apply_append(
         file_name, data, src=src, strategy=strategy,
         segment_bytes=segment_bytes, timer=timer,
     )
+    _obs_health.record_damage(
+        "update", file_name, generation=out.get("generation"),
+    )
+    return out
 
 
 @_observed_file_op("update_many")
@@ -3649,11 +3717,15 @@ def update_file_many(
     """
     from .update import apply_update_many
 
-    return apply_update_many(
+    out = apply_update_many(
         file_name, edits, strategy=strategy,
         segment_bytes=segment_bytes, timer=timer, group_edits=group_edits,
         group_tag=group_tag, stage_hook=stage_hook,
     )
+    _obs_health.record_damage(
+        "update", file_name, generation=out.get("generation"),
+    )
+    return out
 
 
 def recover_archive(file_name: str) -> str:
@@ -3836,6 +3908,15 @@ def scan_file(
             ).labels(state="silent_bitrot").inc(len(located))
             scan = scan.excluding(
                 {i: chunk_file_name(in_file, i) for i in located}
+            )
+        if located or verdict == "unlocatable":
+            # Health plane: every located chunk is an individually
+            # verified attribution (partial sweeps included — the
+            # verdict field carries the completeness caveat).
+            _obs_health.record_damage(
+                "syndrome", in_file, chunks=sorted(located),
+                k=scan.k, p=scan.p, w=scan.w,
+                generation=scan.generation, verdict=verdict,
             )
     try:
         _select_decodable_subset(scan)

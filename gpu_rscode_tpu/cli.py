@@ -141,7 +141,16 @@ Subcommands: rs update ARCHIVE --at OFF --in DELTA [--recover] [--json]
             rs doctor [--json]
             (one-shot environment diagnostic: backend/devices, native
             lib, mesh sanity, RS_* knobs, ledger/endpoint reachability,
-            serve-daemon health, roofline freshness)
+            serve-daemon health, roofline freshness, fleet health)
+            rs health [--json] [--top N] [--watch [SECS] [--count N]]
+            [--ledger PATH] [--snapshot]
+            (risk-ranked fleet durability report replayed from the
+            RS_RUNLOG damage ledger: per-archive distance-to-data-loss
+            margin weighted by bitrot recurrence, scrub staleness and
+            repair-failure history; --snapshot checkpoints the state
+            back to the ledger; the same ranking feeds the daemon's
+            GET /health, rs_durability_* gauges and the repair
+            work queue; docs/HEALTH.md)
             rs serve [--root DIR] [--port P] [--addr A] [--depth N]
             [--batch-ms MS] [--max-batch N] [--workers N]
             [--warm K,N[,W]] [--faults SPEC] [--slo SPEC]
@@ -630,6 +639,10 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.doctor import main as _doctor_main
 
         return _doctor_main(argv[1:])
+    if argv and argv[0] == "health":
+        from .obs.health import main as _health_main
+
+        return _health_main(argv[1:])
     if argv and argv[0] == "serve":
         from .serve.daemon import main as _serve_daemon_main
 

@@ -58,7 +58,7 @@ SCHEMA_VERSION = 1
 # always present, whatever the environment looks like.
 SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "update",
             "store", "strategies", "ledger", "metrics_endpoint", "serve",
-            "slo", "roofline")
+            "slo", "roofline", "health")
 
 
 def _jax_section() -> dict:
@@ -357,6 +357,7 @@ def _ledger_section() -> tuple[dict, list[dict]]:
     p = _runlog.path()
     records: list[dict] = []
     out: dict = {"path": p, "exists": False, "records": 0,
+                 "damage_records": 0, "health_snapshots": 0,
                  "writable": None, "error": None}
     if not p:
         out["error"] = "RS_RUNLOG unset (no persistent run ledger)"
@@ -366,6 +367,13 @@ def _ledger_section() -> tuple[dict, list[dict]]:
         try:
             records = _runlog.read_records(p)
             out["records"] = len(records)
+            # Damage-plane volume (obs/health.py): how much of the
+            # ledger is the durability event stream vs op history.
+            out["damage_records"] = sum(
+                1 for r in records if r.get("kind") == "rs_damage")
+            out["health_snapshots"] = sum(
+                1 for r in records
+                if r.get("kind") == "rs_health_snapshot")
         except Exception as e:
             out["error"] = f"{type(e).__name__}: {e}"
     # Writability probe that MUTATES NOTHING: doctor diagnoses state, it
@@ -385,6 +393,40 @@ def _ledger_section() -> tuple[dict, list[dict]]:
         if not out["writable"]:
             out["error"] = f"parent directory {parent!r} not writable"
     return out, records
+
+
+def _health_section(ledger_records: list[dict]) -> dict:
+    """Fleet durability-health facts (obs/health.py, docs/HEALTH.md):
+    replay the shared ledger-record list — parsed once by
+    :func:`_ledger_section` — into health state and report snapshot
+    freshness, the at-risk count and the repair work-queue depth."""
+    out: dict = {"enabled": _runlog.enabled(), "tracked": 0, "at_risk": 0,
+                 "work_queue_depth": 0, "buckets": None, "events": 0,
+                 "snapshots": 0, "snapshots_corrupt": 0,
+                 "snapshot_age_s": None, "events_since_snapshot": 0,
+                 "error": None}
+    if not out["enabled"]:
+        out["error"] = "RS_RUNLOG unset (no damage ledger)"
+        return out
+    try:
+        from . import health as _health
+
+        state = _health.replay(ledger_records)
+        report = _health.fleet_report(state)
+        out["tracked"] = report["total"]
+        out["at_risk"] = report["at_risk"]
+        out["work_queue_depth"] = report["work_queue_depth"]
+        out["buckets"] = report["buckets"]
+        out["events"] = report["events"]
+        out["snapshots"] = report["snapshots"]
+        out["snapshots_corrupt"] = report["snapshots_corrupt"]
+        out["events_since_snapshot"] = report["events_since_snapshot"]
+        if report["snapshot_ts"]:
+            out["snapshot_age_s"] = round(
+                max(0.0, time.time() - report["snapshot_ts"]), 3)
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
 
 
 def _endpoint_section(probe: bool = True) -> dict:
@@ -570,6 +612,7 @@ def collect(probe_endpoint: bool = True,
         "serve": _serve_section(probe_endpoint),
         "slo": _slo_section(probe_endpoint),
         "roofline": _roofline_section(ledger_records),
+        "health": _health_section(ledger_records),
     }
     warnings = []
     if not jax_info["importable"]:
@@ -587,6 +630,10 @@ def collect(probe_endpoint: bool = True,
     if report["roofline"]["cached"] and not report["roofline"]["fresh"]:
         warnings.append("roofline calibration is stale — rs analyze will "
                         "re-probe (or pass --refresh-roofline)")
+    if report["health"]["at_risk"]:
+        warnings.append(f"{report['health']['at_risk']} archive(s) at "
+                        "risk — run `rs health` for the ranked fleet "
+                        "table and repair the top entries")
     report["warnings"] = warnings
     return report
 
@@ -621,6 +668,22 @@ def render(report: dict) -> str:
             + (f"; live: {sl['attainment']['cells']} cell(s), "
                f"{n_breach} breach(es)" if sl["attainment"] is not None
                else "; not probed")
+        )
+    h = report["health"]
+    if not h["enabled"] or h["error"]:
+        health_line = ("[--] health: " + (h["error"] or "unavailable")
+                       if not h["enabled"]
+                       else f"[!!] health: {h['error']}")
+    else:
+        health_line = (
+            f"[{mark(not h['at_risk'])}] health: {h['tracked']} archive(s) "
+            f"tracked, {h['at_risk']} at risk, work queue "
+            f"{h['work_queue_depth']}, {h['snapshots']} snapshot(s)"
+            + (f" (last {h['snapshot_age_s']}s ago, "
+               f"{h['events_since_snapshot']} delta(s) since)"
+               if h["snapshot_age_s"] is not None else "")
+            + (f", {h['snapshots_corrupt']} corrupt snapshot(s) skipped"
+               if h["snapshots_corrupt"] else "")
         )
     lines = [
         f"rs doctor @ {report['host']} "
@@ -700,7 +763,9 @@ def render(report: dict) -> str:
             else f"unavailable ({report['strategies']['error']})"
         ),
         f"[{mark(led['writable'])}] ledger: "
-        + (f"{led['path']} ({led['records']} records)"
+        + (f"{led['path']} ({led['records']} records, "
+           f"{led.get('damage_records', 0)} damage, "
+           f"{led.get('health_snapshots', 0)} health snapshot(s))"
            if led["path"] else "RS_RUNLOG unset"),
         # reachable is None when the probe was skipped (--no-probe): an
         # untested endpoint must not render as an outage.
@@ -727,6 +792,7 @@ def render(report: dict) -> str:
            f"gemm, age {rl['age_s']}s "
            f"({'fresh' if rl['fresh'] else 'STALE'})"
            if rl["cached"] else "not calibrated (run rs analyze)"),
+        health_line,
     ]
     for w in report.get("warnings", []):
         lines.append(f"  warning: {w}")

@@ -192,11 +192,15 @@ def metrics_digest() -> str | None:
 # rs_run measurements (history — one rotated generation of which is
 # enough), these ARE the persistent state their subsystems reload on
 # process start (roofline: obs/attrib.py; schedule/autotune store:
-# docs/XOR.md).  Letting high-volume rs_run traffic rotate them away
-# would silently re-introduce the cold-start cost the store exists to
-# remove.  Carried records are capped at half the rotation budget so a
-# store bigger than the ledger cap cannot re-trigger rotation forever.
-_PRESERVED_KINDS = ("rs_roofline", "rs_xor_schedule", "rs_autotune")
+# docs/XOR.md; fleet-health checkpoints: obs/health.py — the latest
+# snapshot bounds the damage-replay window, so rotating it away would
+# unbound replay back to whatever deltas survive).  Letting high-volume
+# rs_run traffic rotate them away would silently re-introduce the
+# cold-start cost the store exists to remove.  Carried records are
+# capped at half the rotation budget so a store bigger than the ledger
+# cap cannot re-trigger rotation forever.
+_PRESERVED_KINDS = ("rs_roofline", "rs_xor_schedule", "rs_autotune",
+                    "rs_health_snapshot")
 
 
 def _rotate(p: str, max_bytes: int) -> None:
@@ -236,6 +240,9 @@ def _rotate(p: str, max_bytes: int) -> None:
                              rec.get("k"), rec.get("p"), rec.get("w"))
                 elif kind == "rs_xor_schedule":
                     ident = (kind, rec.get("digest"), rec.get("cse"))
+                elif kind == "rs_health_snapshot":
+                    # Fleet-wide state: one latest checkpoint, any host.
+                    ident = (kind,)
                 else:  # rs_roofline
                     ident = (kind, rec.get("host"))
                 latest.pop(ident, None)  # re-insert: dict order = recency
@@ -468,6 +475,7 @@ def filter_records(
     w: int | None = None,
     strategy: str | None = None,
     host: str | None = None,
+    cls: str | None = None,
 ) -> list[dict]:
     """Select ledger (or bench-capture) records by op + config.
 
@@ -478,13 +486,29 @@ def filter_records(
     ``config`` dict and skip records that lack the field only when the
     filter asks for it.  Capture headers, roofline-calibration records
     (``rs_roofline``, obs/attrib.py), persistent-store records
-    (``rs_xor_schedule``/``rs_autotune``, ops/xor_gemm.py + tune.py)
-    and per-request lifecycle events (``rs_request``, obs/reqtrace.py —
-    their wall includes queue/batch wait, so trending them as op
-    throughput would corrupt regression baselines; ``rs slo --runlog``
-    is their reader) are dropped — none of them are op measurements,
-    and they must not occupy trend-window slots or print as junk rows.
+    (``rs_xor_schedule``/``rs_autotune``/``rs_ring_schedule``,
+    ops/xor_gemm.py + tune.py + ring_gemm.py), per-request lifecycle
+    events (``rs_request``, obs/reqtrace.py — their wall includes
+    queue/batch wait, so trending them as op throughput would corrupt
+    regression baselines; ``rs slo --runlog`` is their reader) and
+    damage-plane records (``rs_damage``/``rs_health_snapshot``,
+    obs/health.py) are dropped — none of them are op measurements, and
+    they must not occupy trend-window slots or print as junk rows.
+
+    ``cls`` inverts the default: it selects ONE event class instead of
+    the op-measurement stream — ``cls="damage"`` returns only the
+    ``rs_damage`` records (the health replay path, which must not scan
+    every file-op record), ``cls="request"`` the ``rs_request`` stream.
+    The host filter still applies; op/config filters are moot for
+    class-selected records (they carry no ``config``) and are ignored.
     """
+    if cls is not None:
+        want = "rs_" + cls
+        return [
+            r for r in records
+            if r.get("kind") == want
+            and (host is None or r.get("host") == host)
+        ]
     out = []
     header_tool = None
     for r in records:
@@ -492,7 +516,9 @@ def filter_records(
             header_tool = r.get("tool")
             continue
         if r.get("kind") in ("rs_roofline", "rs_xor_schedule",
-                             "rs_autotune", "rs_request"):
+                             "rs_autotune", "rs_ring_schedule",
+                             "rs_request", "rs_damage",
+                             "rs_health_snapshot"):
             continue
         cfg = r.get("config") or {}
         if op is not None and op not in (

@@ -402,6 +402,60 @@ def plan_object_iteration(seed: int, i: int,
     }
 
 
+def plan_health_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
+    """The ``health`` convergence class: prove the fleet durability
+    plane (obs/health.py, docs/HEALTH.md) tracks reality end to end —
+    induced damage surfaces at the TOP of the risk ranking with the
+    exact per-chunk damage map, repair clears it, and replaying the
+    damage ledger is restart-stable (snapshot+delta replay byte-equal to
+    pure-delta replay from genesis).
+
+    Each iteration runs a small fleet (2-4 archives, one designated
+    victim) against its own private ledger; damage is 1..p chunks of the
+    victim — always within the repair bound, because the contract under
+    test is CONVERGENCE (damage -> ranked -> repaired -> cleared), the
+    unrecoverable verdicts belong to the classic/silent classes.
+
+    Deterministic from ``(seed, i)`` on its own derived stream
+    (``rs-chaos-health:*``); verdict rows carry only ints/bools (never
+    risk floats or timestamps — risk depends on wall-clock scrub age),
+    so the verdict digest stays a function of the seed alone.
+    """
+    rng = random.Random(f"rs-chaos-health:{seed}:{i}")
+    k = rng.randint(2, 5)
+    p = rng.randint(1, 3)
+    w = 16 if rng.random() < 0.2 else 8
+    n_archives = rng.randint(2, 4)
+    sizes = [rng.randint(256, max_bytes) for _ in range(n_archives)]
+    victim = rng.randrange(n_archives)
+    n_damage = rng.randint(1, p)
+    targets = sorted(rng.sample(range(k + p), n_damage))
+    events = []
+    for c in targets:
+        kind = rng.choice(("bitrot", "torn", "unlink"))
+        if kind == "bitrot":
+            events.append({"kind": "bitrot", "chunk": c,
+                           "count": rng.randint(1, 64)})
+        elif kind == "torn":
+            events.append({"kind": "torn", "chunk": c,
+                           "keep_frac": rng.random() * 0.9})
+        else:
+            events.append({"kind": "unlink", "chunk": c})
+    return {
+        "seed": seed,
+        "iter": i,
+        "mode": "health",
+        "k": k,
+        "p": p,
+        "w": w,
+        "archives": n_archives,
+        "sizes": sizes,
+        "victim": victim,
+        "events": events,
+        "faults": "",
+    }
+
+
 def plan_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
     """The deterministic schedule for iteration ``i`` of master ``seed``."""
     rng = _iter_rng(seed, i)
@@ -625,6 +679,8 @@ def run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
             return _run_update_group_iteration(cfg, workdir, keep=keep)
         if cfg.get("mode") == "object":
             return _run_object_iteration(cfg, workdir, keep=keep)
+        if cfg.get("mode") == "health":
+            return _run_health_iteration(cfg, workdir, keep=keep)
         return _run_iteration(cfg, workdir, keep=keep)
 
 
@@ -1224,6 +1280,171 @@ def _run_silent_iteration(cfg: dict, workdir: str, *,
     }
 
 
+def _run_health_iteration(cfg: dict, workdir: str, *,
+                          keep: bool = False) -> dict:
+    """One ``health``-class iteration: encode a small fleet against a
+    private damage ledger, hurt the victim, and prove the durability
+    plane converges (docs/HEALTH.md):
+
+    * a clean fleet ranks nothing for repair;
+    * induced damage puts the victim at rank 1 with the EXACT per-chunk
+      state map the schedule predicts (unlink -> missing, torn ->
+      truncated, bitrot -> crc_mismatch), margin ``p - lost``, and a
+      ``repair`` work-queue head;
+    * a checkpoint snapshot taken mid-history, then repair + rescan:
+      the victim's damage map clears and no repair stays queued;
+    * replay is restart-stable: two fresh replays agree byte-for-byte,
+      and snapshot+delta replay equals pure-delta replay from genesis —
+      the daemon kill/restart contract.
+    """
+    from .. import api
+    from ..obs import health as _health
+    from ..utils.fileformat import chunk_size_for
+
+    seed, i = cfg["seed"], cfg["iter"]
+    k, p, w = cfg["k"], cfg["p"], cfg["w"]
+    rng = random.Random(f"rs-chaos-health-run:{seed}:{i}")
+    base = os.path.join(workdir, f"iter{i}")
+    os.makedirs(base, exist_ok=True)
+    ledger = os.path.join(base, "health_ledger.jsonl")
+    damaged = sorted({ev["chunk"] for ev in cfg["events"]})
+    # Private ledger + pinned health knobs for the iteration: verdicts
+    # must be a function of the seed alone, and the ambient ledger must
+    # not absorb (or leak) this fleet's damage events.
+    saved_env = {
+        kk: os.environ.get(kk)
+        for kk in ("RS_RUNLOG", "RS_RUNLOG_MAX_BYTES",
+                   "RS_HEALTH_SCRUB_MAX_AGE_S", "RS_HEALTH_AT_RISK",
+                   "RS_SCHEDULE_STORE")
+    }
+    ok = False
+    try:
+        os.environ["RS_RUNLOG"] = ledger
+        os.environ.pop("RS_RUNLOG_MAX_BYTES", None)
+        os.environ.pop("RS_HEALTH_SCRUB_MAX_AGE_S", None)
+        os.environ.pop("RS_HEALTH_AT_RISK", None)
+        os.environ["RS_SCHEDULE_STORE"] = "off"
+
+        fnames = []
+        for a, size in enumerate(cfg["sizes"]):
+            fname = os.path.join(base, f"chaos_health_{i}_{a}.bin")
+            data = random.Random(
+                f"rs-chaos-data:{seed}:{i}:{a}").randbytes(size)
+            with open(fname, "wb") as fp:
+                fp.write(data)
+            api.encode_file(fname, k, p, checksums=True, w=w,
+                            segment_bytes=_SEGMENT_BYTES)
+            fnames.append(fname)
+        for f in fnames:
+            api.scan_file(f, segment_bytes=_SEGMENT_BYTES)
+        state = _health.load(ledger)
+        _check(len(state["archives"]) == len(fnames), cfg,
+               "clean scans did not track every archive")
+        _check(
+            not [q for q in _health.work_queue(state)
+                 if q["action"] == "repair"],
+            cfg, "clean fleet queued repairs",
+        )
+
+        victim = os.path.abspath(fnames[cfg["victim"]])
+        chunk = chunk_size_for(cfg["sizes"][cfg["victim"]], k, w // 8)
+        _apply_events(victim, cfg["events"], chunk, rng)
+        for f in fnames:
+            api.scan_file(f, segment_bytes=_SEGMENT_BYTES)
+        state = _health.load(ledger)
+        report = _health.fleet_report(state)
+        top = report["archives"][0]
+        _check(top["archive"] == victim, cfg,
+               f"induced damage ranked {top['archive']!r} first, "
+               f"not the victim")
+        _check(top["lost"] == len(damaged), cfg,
+               f"victim lost {top['lost']}, schedule damaged "
+               f"{len(damaged)}")
+        _check(top["margin"] == p - len(damaged), cfg,
+               f"victim margin {top['margin']} != p - lost")
+        expect = {
+            str(ev["chunk"]): {"unlink": "missing", "torn": "truncated",
+                               "bitrot": "crc_mismatch"}[ev["kind"]]
+            for ev in cfg["events"]
+        }
+        _check(top["chunks"] == expect, cfg,
+               f"damage map {top['chunks']} != schedule {expect}")
+        wq = report["work_queue"]
+        _check(
+            bool(wq) and wq[0]["archive"] == victim
+            and wq[0]["action"] == "repair",
+            cfg, "victim is not the work queue's repair head",
+        )
+
+        # Checkpoint mid-history (the "daemon killed mid-scrub" state),
+        # then keep appending deltas on top of it.
+        _health.write_snapshot(state, ledger)
+
+        rebuilt = api.repair_file(victim, segment_bytes=_SEGMENT_BYTES)
+        _check(sorted(rebuilt) == damaged, cfg,
+               f"repair rebuilt {sorted(rebuilt)}, schedule damaged "
+               f"{damaged}")
+        for f in fnames:
+            api.scan_file(f, segment_bytes=_SEGMENT_BYTES)
+        state = _health.load(ledger)
+        report = _health.fleet_report(state)
+        vrow = next(r for r in report["archives"]
+                    if r["archive"] == victim)
+        _check(vrow["lost"] == 0, cfg,
+               "repair + rescan did not clear the victim's damage map")
+        _check(
+            not [q for q in report["work_queue"]
+                 if q["action"] == "repair"],
+            cfg, "repairs still queued after a clean rescan",
+        )
+
+        # Restart stability: replays of the same ledger agree
+        # byte-for-byte, with and without the checkpoint.
+        c_a = _health.canonical(_health.load(ledger))
+        c_b = _health.canonical(_health.load(ledger))
+        _check(c_a == c_b, cfg, "re-replay is not deterministic")
+        c_pure = _health.canonical(
+            _health.load(ledger, use_snapshots=False))
+        _check(c_a == c_pure, cfg,
+               "snapshot+delta replay != pure-delta replay")
+        ok = True
+    except ChaosFailure:
+        raise
+    except Exception as e:
+        raise ChaosFailure(
+            cfg, f"unexpected {type(e).__name__}: {e}"
+        ) from e
+    finally:
+        for kk, vv in saved_env.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+        verdict = "pass" if ok else "fail"
+        _metrics.counter(
+            "rs_chaos_iterations_total", "chaos-harness iteration verdicts"
+        ).labels(verdict=verdict).inc()
+        if _runlog.enabled():
+            _runlog.record({
+                "op": "chaos_iter",
+                "config": {"k": k, "n": k + p, "w": w},
+                "bytes": sum(cfg["sizes"]),
+                "chaos": {
+                    "seed": seed, "iter": i, "mode": "health",
+                    "events": cfg["events"], "faults": cfg["faults"],
+                },
+                "outcome": "ok" if ok else "error",
+            })
+        if ok and not keep:
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "iter": i, "mode": "health", "k": k, "p": p, "w": w,
+        "archives": len(cfg["sizes"]), "damaged": damaged,
+        "top_is_victim": True, "risk_cleared": True,
+        "replay_identical": True, "verdict": "pass",
+    }
+
+
 def _run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
     from .. import api
     from ..utils.fileformat import (
@@ -1452,6 +1673,14 @@ def main(argv: list[str] | None = None) -> int:
                     "sequential mirror of the committed ops, and the "
                     "index must never reference rolled-back bytes — "
                     "own seed stream (docs/STORE.md)")
+    ap.add_argument("--health", action="store_true",
+                    help="run the HEALTH convergence class: encode a "
+                    "small fleet against a private damage ledger, hurt "
+                    "one victim, and require the durability plane to "
+                    "rank it first with the exact predicted chunk-state "
+                    "map, clear it after repair, and replay snapshot+"
+                    "delta byte-identically — own seed stream "
+                    "(docs/HEALTH.md)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line per iteration")
     ap.add_argument("--keep", action="store_true",
@@ -1474,9 +1703,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"rs chaos: bad --repro JSON: {e}", file=sys.stderr)
             return 2
     else:
-        if sum((args.silent, args.update, args.object)) > 1:
-            print("rs chaos: --silent / --update / --object conflict; "
-                  "pick one workload class", file=sys.stderr)
+        if sum((args.silent, args.update, args.object, args.health)) > 1:
+            print("rs chaos: --silent / --update / --object / --health "
+                  "conflict; pick one workload class", file=sys.stderr)
             return 2
         if args.group and not args.update:
             print("rs chaos: --group modifies --update (the grouped "
@@ -1488,6 +1717,7 @@ def main(argv: list[str] | None = None) -> int:
             else plan_update_iteration if args.update
             else plan_silent_iteration if args.silent
             else plan_object_iteration if args.object
+            else plan_health_iteration if args.health
             else plan_iteration
         )
         cfgs = [plan(args.seed, i, args.max_bytes) for i in indices]
@@ -1506,7 +1736,7 @@ def main(argv: list[str] | None = None) -> int:
             silent_flag = {
                 "silent": "--silent ", "update": "--update ",
                 "update_group": "--update --group ",
-                "object": "--object ",
+                "object": "--object ", "health": "--health ",
             }.get(cfg.get("mode"), "")
             print(
                 f"rs chaos: replay the original with: rs chaos "

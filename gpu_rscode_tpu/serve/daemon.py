@@ -69,6 +69,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..obs import (
+    health as _health,
     metrics as _metrics,
     reqtrace as _reqtrace,
     runlog as _runlog,
@@ -162,10 +163,18 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             if url.path == "/healthz":
                 self._send_json(200, self.daemon.health())
+            elif url.path == "/health":
+                # Fleet durability report (obs/health.py): replay the
+                # damage ledger, rank by stripe risk.  Distinct from
+                # /healthz — that answers "is the daemon up", this
+                # answers "which archives are closest to data loss".
+                self._send_json(200, self.daemon.fleet_health())
             elif url.path == "/metrics":
                 # Rolling SLO windows age out without new traffic, so
-                # the rs_slo_* gauges refresh at scrape time.
+                # the rs_slo_* gauges refresh at scrape time — and so do
+                # scrub ages: the rs_durability_* gauges re-export too.
                 self.daemon.slo.export_gauges()
+                self.daemon.export_fleet_health()
                 body = _metrics.REGISTRY.render_text().encode()
                 self.send_response(200)
                 self.send_header(
@@ -858,6 +867,35 @@ class ServeDaemon:
             "requests_done": self.requests_done,
             "requests_failed": self.requests_failed,
         }
+
+    def fleet_health(self) -> dict:
+        """``GET /health``: the risk-ranked fleet durability report
+        (obs/health.py) replayed from the damage ledger.  Each call
+        replays the current ledger — concurrent scrub appends are safe
+        to read mid-write (whole-line O_APPEND records; the reader skips
+        a torn tail) — and refreshes the ``rs_durability_*`` gauges."""
+        if not _runlog.enabled():
+            return {
+                "kind": "rs_health", "enabled": False,
+                "error": "no damage ledger (start the daemon with "
+                "RS_RUNLOG set)",
+            }
+        state = _health.load()
+        report = _health.fleet_report(state)
+        report["enabled"] = True
+        _health.export_metrics(report)
+        return report
+
+    def export_fleet_health(self) -> None:
+        """Scrape-time refresh of the ``rs_durability_*`` gauges (the
+        same pattern as the rs_slo_* export: scrub ages advance without
+        new damage traffic, so /metrics re-derives them)."""
+        if _runlog.enabled():
+            try:
+                _health.export_metrics(
+                    _health.fleet_report(_health.load()))
+            except Exception:
+                pass  # exposition must not fail the scrape
 
     def stats(self) -> dict:
         # Warm-path facts next to the queue counters: which strategy

@@ -1,0 +1,535 @@
+"""Fleet durability-health plane (obs/health.py): damage-event emission
+from the api.py detection sites, snapshot+delta replay (incl. across
+ledger rotation and corrupt checkpoints), deterministic risk ranking and
+the work-queue contract, the `rs health` CLI, the doctor section, and
+the serve daemon's GET /health under concurrent scrub writers and across
+kill/restart (docs/HEALTH.md).
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api, cli
+from gpu_rscode_tpu.obs import doctor, health, metrics, runlog
+from gpu_rscode_tpu.serve.daemon import ServeDaemon
+from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+
+@pytest.fixture
+def ledger(tmp_path, monkeypatch):
+    p = str(tmp_path / "runlog.jsonl")
+    monkeypatch.setenv("RS_RUNLOG", p)
+    monkeypatch.delenv("RS_RUNLOG_MAX_BYTES", raising=False)
+    monkeypatch.delenv("RS_HEALTH_SCRUB_MAX_AGE_S", raising=False)
+    monkeypatch.delenv("RS_HEALTH_AT_RISK", raising=False)
+    yield p
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def _mkfile(tmp_path, size, name="f.bin", seed=0):
+    path = str(tmp_path / name)
+    rng = np.random.default_rng(seed)
+    open(path, "wb").write(
+        rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    )
+    return path
+
+
+def _corrupt(path, idx, offset=10):
+    cf = chunk_file_name(path, idx)
+    with open(cf, "r+b") as fp:
+        fp.seek(offset)
+        b = fp.read(1)
+        fp.seek(offset)
+        fp.write(bytes([b[0] ^ 0xFF]))
+
+
+# ----- pure state machine / scoring (no files, injected now) ----------------
+
+
+def _dmg(event, archive, ts, **extra):
+    return {"kind": "rs_damage", "cls": "damage", "event": event,
+            "archive": archive, "ts": ts, **extra}
+
+
+def test_risk_margin_dominates_modifiers():
+    st = health.replay([
+        _dmg("scan", "/a", 100.0, k=4, p=2, w=8, generation=0,
+             states={"0": "crc_mismatch"}),
+        _dmg("scan", "/b", 100.0, k=4, p=2, w=8, generation=0, states={}),
+    ])
+    ra = health.risk(st["archives"]["/a"], now=100.0)
+    rb = health.risk(st["archives"]["/b"], now=100.0)
+    assert ra["lost"] == 1 and ra["margin"] == 1
+    assert rb["lost"] == 0 and rb["margin"] == 2
+    # One lost chunk scores 1/(p+1) base; the clean archive's modifiers
+    # (stale=0 right after its scan) can never reach that.
+    assert ra["risk"] > rb["risk"]
+    assert ra["terms"]["margin"] == pytest.approx(1 / 3, abs=1e-4)
+    # Full loss saturates the base term.
+    st2 = health.replay([
+        _dmg("scan", "/c", 100.0, k=2, p=1, w=8, generation=0,
+             states={"0": "missing", "1": "missing"}),
+    ])
+    rc = health.risk(st2["archives"]["/c"], now=100.0)
+    assert rc["margin"] == -1 and rc["terms"]["margin"] == 1.0
+    assert health.bucket({**rc, "archive": "/c"}) == "critical"
+
+
+def test_scan_replaces_chunk_map_and_counts_recurrence_transitions():
+    recs = [
+        _dmg("scan", "/a", 1.0, k=4, p=2, states={"1": "crc_mismatch"}),
+        _dmg("scan", "/a", 2.0, k=4, p=2, states={"1": "crc_mismatch"}),
+        _dmg("scan", "/a", 3.0, k=4, p=2, states={}),
+        _dmg("scan", "/a", 4.0, k=4, p=2, states={"1": "crc_mismatch"}),
+    ]
+    a = health.replay(recs)["archives"]["/a"]
+    assert a["chunks"]["1"]["state"] == "crc_mismatch"
+    # Re-scanning the SAME rot is one event; clearing and re-appearing
+    # is a transition — 2 recurrences total, not 3.
+    assert a["bitrot_events"] == 2
+    assert a["last_scrub_ts"] == 4.0
+    # The clean scan at ts=3 really emptied the map.
+    a3 = health.replay(recs[:3])["archives"]["/a"]
+    assert a3["chunks"] == {}
+
+
+def test_update_invalidates_scrub_and_queues_rescrub():
+    recs = [
+        _dmg("scan", "/a", 100.0, k=4, p=2, generation=0, states={}),
+        _dmg("update", "/a", 101.0, generation=1),
+    ]
+    st = health.replay(recs)
+    a = st["archives"]["/a"]
+    assert a["generation"] == 1 and a["scrub_generation"] == 0
+    r = health.risk(a, now=102.0)
+    assert r["scrub_stale"] == 1.0  # the scrub verdict is void
+    wq = health.work_queue(st, now=102.0)
+    assert [q["action"] for q in wq] == ["scrub"]
+    # A fresh scan at the new generation re-validates.
+    st2 = health.replay(
+        recs + [_dmg("scan", "/a", 103.0, k=4, p=2, generation=1,
+                     states={})])
+    assert health.risk(st2["archives"]["/a"], now=103.0)["scrub_stale"] == 0.0
+    assert health.work_queue(st2, now=103.0) == []
+
+
+def test_work_queue_deterministic_rank_order():
+    recs = [
+        _dmg("scan", "/worse", 50.0, k=4, p=2, generation=0,
+             states={"0": "missing", "1": "missing"}),
+        _dmg("scan", "/bad", 50.0, k=4, p=2, generation=0,
+             states={"0": "missing"}),
+        _dmg("scan", "/tied-b", 50.0, k=4, p=2, generation=0,
+             states={"2": "missing"}),
+        _dmg("scan", "/ok", 50.0, k=4, p=2, generation=0, states={}),
+        _dmg("scan", "/stale", 0.0, k=4, p=2, generation=0, states={}),
+    ]
+    st = health.replay(recs)
+    now = 50.0 + health.scrub_max_age_s()  # /stale ages past the horizon
+    wq = health.work_queue(st, now=now)
+    # Risk-desc, then lost-desc, margin-asc, path tiebreak; /ok has a
+    # fresh-enough... actually every scan aged tau here, so /ok queues a
+    # scrub too — but strictly after every repair.
+    assert [q["archive"] for q in wq[:3]] == ["/worse", "/bad", "/tied-b"]
+    assert [q["action"] for q in wq[:3]] == ["repair"] * 3
+    assert {q["action"] for q in wq[3:]} == {"scrub"}
+    # Equal-state tie broken by path: /bad before /tied-b at same
+    # (risk, lost, margin).
+    assert wq[1]["risk"] == wq[2]["risk"]
+    # Deterministic under dict-insertion reordering.
+    wq2 = health.work_queue(health.replay(list(reversed(recs))), now=now)
+    assert [q["archive"] for q in wq2] == [q["archive"] for q in wq]
+    # ...and repeatable.
+    assert health.work_queue(st, now=now) == wq
+
+
+def test_repair_clears_map_keeps_lifetime_counters():
+    recs = [
+        _dmg("scan", "/a", 1.0, k=4, p=2, generation=0,
+             states={"1": "crc_mismatch", "3": "missing"}),
+        _dmg("repair", "/a", 2.0, chunks=[1, 3]),
+    ]
+    a = health.replay(recs)["archives"]["/a"]
+    assert a["chunks"] == {} and a["repairs"] == 1
+    assert a["bitrot_events"] == 1  # recurrence history survives repair
+
+
+def test_repair_failed_weights_risk():
+    base = [_dmg("scan", "/a", 1.0, k=4, p=2, generation=0,
+                 states={"0": "missing"})]
+    st0 = health.replay(base)
+    st1 = health.replay(base + [
+        _dmg("repair_failed", "/a", 2.0, verdict="unrecoverable"),
+        _dmg("repair_failed", "/a", 3.0, verdict="undecided"),
+    ])
+    r0 = health.risk(st0["archives"]["/a"], now=3.0)
+    r1 = health.risk(st1["archives"]["/a"], now=3.0)
+    assert r1["risk"] == pytest.approx(r0["risk"] + health.W_FAIL, abs=1e-4)
+
+
+# ----- snapshot + delta persistence -----------------------------------------
+
+
+def test_snapshot_replay_equals_pure_delta(ledger):
+    health.record_damage("scan", "/a", states={"0": "missing"}, k=4, p=2,
+                         w=8, generation=0, ledger_path=ledger)
+    st = health.replay(runlog.read_records(ledger))
+    health.write_snapshot(st, ledger)
+    health.record_damage("repair", "/a", chunks=[0], ledger_path=ledger)
+    with_snap = health.load(ledger)
+    pure = health.load(ledger, use_snapshots=False)
+    assert health.canonical(with_snap) == health.canonical(pure)
+    assert with_snap["snapshots"] == 1
+    assert with_snap["events_since_snapshot"] == 1  # just the repair delta
+
+
+def test_replay_across_rotation_with_carried_snapshot(ledger, monkeypatch):
+    """The acceptance crash-consistency scenario: damage history, a
+    checkpoint, MORE deltas, then rotation (which carries the snapshot
+    into the live file).  Replay must dedupe the carried copy by snap_id
+    so the rotated generation's post-snapshot deltas still apply."""
+    health.record_damage("scan", "/a", states={"0": "missing"}, k=4, p=2,
+                         w=8, generation=0, ledger_path=ledger)
+    st = health.replay(runlog.read_records(ledger))
+    health.write_snapshot(st, ledger)
+    # Post-snapshot delta that will live in the ROTATED generation.
+    health.record_damage("scan", "/a",
+                         states={"0": "missing", "1": "crc_mismatch"},
+                         k=4, p=2, w=8, generation=0, ledger_path=ledger)
+    baseline = health.canonical(health.load(ledger))
+    # Force EXACTLY ONE rotation: the cap is big enough that the
+    # half-budget carry fits the snapshot, and the pad volume stays
+    # under a second rotation (``.1`` keeps one generation — a second
+    # rotation would legitimately drop the pre-snapshot history, which
+    # is precisely the replay-window bound snapshots exist to provide).
+    monkeypatch.setenv("RS_RUNLOG_MAX_BYTES", "4000")
+    for i in range(12):
+        runlog.record({"op": "encode", "pad": "x" * 256, "i": i}, ledger)
+    assert os.path.exists(ledger + ".1")
+    recs = runlog.read_records(ledger)
+    snaps = [r for r in recs if r.get("kind") == health.SNAPSHOT_KIND]
+    assert len(snaps) >= 2  # original + rotation carry
+    assert len({s["snap_id"] for s in snaps}) == 1  # same checkpoint
+    st2 = health.replay(recs)
+    assert health.canonical(st2) == baseline
+    # The chunk-1 delta recorded AFTER the snapshot survived the carry.
+    assert st2["archives"]["/a"]["chunks"]["1"]["state"] == "crc_mismatch"
+    # And still equals pure-delta replay (damage records all survive —
+    # the cap padding rotated, their generation folds back in).
+    assert health.canonical(
+        health.replay(recs, use_snapshots=False)) == baseline
+
+
+def test_corrupt_and_foreign_snapshots_skipped(ledger):
+    health.record_damage("scan", "/a", states={"0": "missing"}, k=4, p=2,
+                         generation=0, ledger_path=ledger)
+    st = health.replay(runlog.read_records(ledger))
+    good = health.snapshot_record(st)
+    bad_digest = dict(good, snap_id="deadbeef0001",
+                      payload_digest="0" * 16)
+    foreign = dict(good, snap_id="deadbeef0002",
+                   algo_version=health.HEALTH_ALGO + 1)
+    malformed = dict(good, snap_id="deadbeef0003", archives="not-a-dict")
+    for rec in (bad_digest, foreign, malformed):
+        runlog.record(rec, ledger)
+    health.record_damage("repair", "/a", chunks=[0], ledger_path=ledger)
+    st2 = health.load(ledger)
+    # All three rejected, deltas on both sides still applied.
+    assert st2["snapshots"] == 0 and st2["snapshots_corrupt"] == 3
+    assert st2["archives"]["/a"]["chunks"] == {}
+    assert health.canonical(st2) == health.canonical(
+        health.load(ledger, use_snapshots=False))
+
+
+# ----- runlog integration ----------------------------------------------------
+
+
+def test_filter_records_damage_class_and_default_drop(ledger, monkeypatch):
+    health.record_damage("scan", "/a", states={}, k=4, p=2, generation=0,
+                         ledger_path=ledger)
+    runlog.record({"op": "encode", "bytes": 1}, ledger)
+    health.record_damage("syndrome", "/a", chunks=[2], verdict="located",
+                         ledger_path=ledger)
+    st = health.replay(runlog.read_records(ledger))
+    health.write_snapshot(st, ledger)
+    recs = runlog.read_records(ledger)
+    dmg = runlog.filter_records(recs, cls="damage")
+    assert [r["event"] for r in dmg] == ["scan", "syndrome"]
+    # Damage + snapshot records stay OUT of the default trend stream.
+    assert [r.get("op") for r in runlog.filter_records(recs)] == ["encode"]
+    # The class filter still works across rotation.
+    monkeypatch.setenv("RS_RUNLOG_MAX_BYTES", "500")
+    for i in range(20):
+        runlog.record({"op": "encode", "pad": "y" * 48, "i": i}, ledger)
+    health.record_damage("repair", "/a", chunks=[2], ledger_path=ledger)
+    dmg2 = runlog.filter_records(runlog.read_records(ledger), cls="damage")
+    assert [r["event"] for r in dmg2][-1] == "repair"
+
+
+# ----- end to end through the real api detection sites ----------------------
+
+
+def test_scan_corrupt_repair_lifecycle(tmp_path, ledger):
+    """Encode -> clean scan -> corrupt -> scan ranks it -> repair ->
+    rescan clears: the CLI-visible acceptance loop, via real files."""
+    path = _mkfile(tmp_path, 40_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    api.scan_file(path)
+    st = health.load(ledger)
+    key = os.path.abspath(path)
+    assert st["archives"][key]["chunks"] == {}
+    assert st["archives"][key]["k"] == 3 and st["archives"][key]["p"] == 2
+
+    _corrupt(path, 1)
+    os.unlink(chunk_file_name(path, 4))
+    api.scan_file(path)
+    rep = health.fleet_report(health.load(ledger))
+    top = rep["archives"][0]
+    assert top["archive"] == key
+    assert top["chunks"] == {"1": "crc_mismatch", "4": "missing"}
+    assert top["lost"] == 2 and top["margin"] == 0
+    assert top["bucket"] == "critical"
+    assert rep["work_queue"][0] == {
+        "archive": key, "action": "repair", "risk": top["risk"],
+        "margin": 0, "lost": 2}
+
+    rebuilt = api.repair_file(path)
+    assert sorted(rebuilt) == [1, 4]
+    api.scan_file(path)
+    rep2 = health.fleet_report(health.load(ledger))
+    row = next(r for r in rep2["archives"] if r["archive"] == key)
+    assert row["lost"] == 0 and row["repairs"] >= 1
+    assert not [q for q in rep2["work_queue"] if q["action"] == "repair"]
+
+
+def test_repair_failed_event_from_unrecoverable_archive(tmp_path, ledger):
+    path = _mkfile(tmp_path, 20_000)
+    api.encode_file(path, 3, 1, checksums=True)
+    for idx in (0, 2):
+        os.unlink(chunk_file_name(path, idx))
+    with pytest.raises(Exception):
+        api.repair_file(path)
+    dmg = runlog.filter_records(runlog.read_records(ledger), cls="damage")
+    fails = [r for r in dmg if r["event"] == "repair_failed"]
+    assert fails and fails[-1]["verdict"] == "unrecoverable"
+    a = health.load(ledger)["archives"][os.path.abspath(path)]
+    assert a["repair_failures"] >= 1
+
+
+def test_update_event_bumps_generation(tmp_path, ledger):
+    path = _mkfile(tmp_path, 30_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    api.scan_file(path)
+    api.update_file(path, 100, b"\xaa" * 64)
+    a = health.load(ledger)["archives"][os.path.abspath(path)]
+    assert a["updates"] == 1
+    assert a["generation"] > (a["scrub_generation"] or 0)
+    wq = health.work_queue(health.load(ledger))
+    assert [q["action"] for q in wq] == ["scrub"]
+
+
+# ----- rs health CLI ---------------------------------------------------------
+
+
+def test_cli_health_json_table_snapshot(tmp_path, ledger, capsys):
+    path = _mkfile(tmp_path, 30_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 0)
+    api.scan_file(path)
+    capsys.readouterr()
+    assert cli.main(["health", "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert rep["kind"] == "rs_health" and rep["total"] == 1
+    assert rep["archives"][0]["chunks"] == {"0": "crc_mismatch"}
+    assert cli.main(["health", "--top", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "fleet: 1 archives tracked" in out and "RISK" in out
+    # --snapshot checkpoints back into the ledger.
+    assert cli.main(["health", "--snapshot", "--json"]) == 0
+    capsys.readouterr()
+    snaps = [r for r in runlog.read_records(ledger)
+             if r.get("kind") == health.SNAPSHOT_KIND]
+    assert len(snaps) == 1
+    assert snaps[0]["algo_version"] == health.HEALTH_ALGO
+    assert snaps[0]["payload_digest"] == health.payload_digest(
+        snaps[0]["archives"])
+
+
+def test_cli_health_requires_ledger(monkeypatch, capsys):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    assert cli.main(["health"]) == 2
+    assert "no ledger" in capsys.readouterr().err
+
+
+def test_cli_health_watch_count(tmp_path, ledger, capsys):
+    _mkfile(tmp_path, 10_000)
+    health.record_damage("scan", "/a", states={}, k=2, p=1, generation=0,
+                         ledger_path=ledger)
+    assert cli.main(["health", "--json", "--watch", "0.05",
+                     "--count", "2"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    assert all(json.loads(ln)["total"] == 1 for ln in lines)
+
+
+# ----- doctor ----------------------------------------------------------------
+
+
+def test_doctor_health_section(tmp_path, ledger, capsys):
+    path = _mkfile(tmp_path, 30_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 0)
+    api.scan_file(path)
+    report = doctor.collect()
+    assert set(doctor.SECTIONS) <= set(report)
+    h = report["health"]
+    assert h["enabled"] and h["tracked"] == 1
+    assert h["work_queue_depth"] == 1
+    assert report["ledger"]["damage_records"] >= 1
+    text = doctor.render(report)
+    assert "health:" in text and "damage" in text
+
+
+# ----- serve daemon ----------------------------------------------------------
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+def test_daemon_get_health_under_concurrent_scrub_writers(tmp_path, ledger):
+    """GET /health replays the ledger WHILE scrub writers append damage
+    records: every response must parse as a full rs_health report (the
+    torn-tail-tolerant reader contract), never a 500."""
+    paths = [_mkfile(tmp_path, 12_000, name=f"c{i}.bin", seed=i)
+             for i in range(2)]
+    for p in paths:
+        api.encode_file(p, 3, 2, checksums=True)
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=2)
+    d.start()
+    try:
+        stop = threading.Event()
+        errs: list = []
+
+        def scrubber(path):
+            while not stop.is_set():
+                try:
+                    api.scan_file(path)
+                except Exception as e:  # pragma: no cover
+                    errs.append(e)
+                    return
+
+        threads = [threading.Thread(target=scrubber, args=(p,))
+                   for p in paths]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(10):
+                st, rep = _get_json(d.port, "/health")
+                assert st == 200
+                assert rep["kind"] == "rs_health" and rep["enabled"]
+                assert rep["total"] <= 2
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=30)
+        assert not errs
+        # Metrics exposition carries the durability gauges.
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert "rs_durability_archives_tracked" in text
+        assert 'rs_durability_stripe_risk{bucket="critical"}' in text
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_daemon_health_survives_kill_restart(tmp_path, ledger):
+    """Kill the daemon mid-history (after a snapshot + more deltas) and
+    restart: GET /health must replay to the same per-archive state the
+    ledger holds — byte-identical archives payload."""
+    path = _mkfile(tmp_path, 20_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 2)
+    api.scan_file(path)
+    health.write_snapshot(health.load(ledger), ledger)
+    api.repair_file(path)
+    api.scan_file(path)
+
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=2)
+    d.start()
+    try:
+        _, before = _get_json(d.port, "/health")
+    finally:
+        d.close(drain=False, timeout=30)  # the "kill": no clean drain
+
+    d2 = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=2)
+    d2.start()
+    try:
+        _, after = _get_json(d2.port, "/health")
+    finally:
+        d2.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+    key = os.path.abspath(path)
+    strip = lambda rep: json.dumps(  # noqa: E731
+        [{kk: r[kk] for kk in r
+          if kk not in ("risk", "scrub_age_s", "scrub_stale", "terms",
+                        "bucket")}
+         for r in rep["archives"]], sort_keys=True)
+    assert strip(before) == strip(after)
+    assert before["archives"][0]["archive"] == key
+    assert before["archives"][0]["lost"] == 0
+    # And both equal a direct replay of the ledger.
+    direct = health.fleet_report(health.load(ledger))
+    assert strip(direct) == strip(after)
+
+
+def test_daemon_health_disabled_without_ledger(tmp_path, monkeypatch):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=2)
+    d.start()
+    try:
+        st, rep = _get_json(d.port, "/health")
+        assert st == 200
+        assert rep["kind"] == "rs_health" and rep["enabled"] is False
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+# ----- chaos -----------------------------------------------------------------
+
+
+def test_chaos_health_class_smoke():
+    from gpu_rscode_tpu.resilience import chaos
+
+    cfgs = [chaos.plan_health_iteration(7, i) for i in range(4)]
+    assert all(c["mode"] == "health" for c in cfgs)
+    assert cfgs == [chaos.plan_health_iteration(7, i) for i in range(4)]
+    # Damage never exceeds parity: the class proves CONVERGENCE, so
+    # every schedule must be repairable by construction.
+    for c in cfgs:
+        assert 1 <= len(c["events"]) <= c["p"]
+        assert 0 <= c["victim"] < len(c["sizes"])
+
+
+@pytest.mark.slow
+def test_chaos_health_iterations(tmp_path):
+    from gpu_rscode_tpu.resilience import chaos
+
+    rc = chaos.main(["--health", "--seed", "3", "--iters", "2",
+                     "--dir", str(tmp_path), "--json"])
+    assert rc == 0
