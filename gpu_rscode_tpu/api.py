@@ -3825,12 +3825,28 @@ def delete_object(root: str, bucket: str, key: str) -> dict:
     return _store.open_bucket(root, bucket).delete(key)
 
 
-def list_objects(root: str, bucket: str) -> list[dict]:
+def list_objects(root: str, bucket: str, *,
+                 prefix: str = "") -> list[dict]:
     """Live objects in the bucket (tombstoned keys excluded), sorted by
-    key — ``rs object ls``."""
+    key — ``rs object ls``.  ``prefix`` narrows to keys starting with
+    it; for bounded pages over a huge bucket use
+    :func:`list_objects_page`."""
     from . import store as _store
 
-    return _store.open_bucket(root, bucket).list_objects()
+    return _store.open_bucket(root, bucket).list_objects(prefix=prefix)
+
+
+def list_objects_page(root: str, bucket: str, *, prefix: str = "",
+                      limit: int = 0, cursor: str | None = None) -> dict:
+    """One bounded page of live objects — ``rs object ls --limit``:
+    ``{"objects", "truncated", "next"}`` where ``next`` is the opaque
+    cursor resuming after the page's last key (None on the final
+    page).  ``limit <= 0`` uses ``RS_STORE_LIST_LIMIT`` semantics from
+    the caller (here: no bound)."""
+    from . import store as _store
+
+    return _store.open_bucket(root, bucket).list_page(
+        prefix=prefix, limit=limit, cursor=cursor)
 
 
 def stat_object(root: str, bucket: str, key: str) -> dict:

@@ -206,14 +206,21 @@ def _store_section(root: str | None = None) -> dict:
         "root": None, "probed": False, "buckets": {},
         "objects": 0, "live_bytes": 0, "dead_bytes": 0,
         "pending_compactions": 0, "pending_drops": 0,
+        "snapshots": 0, "segments": 0,
         "knobs": {}, "error": None,
     }
     try:
-        from ..store import compact_dead_frac, probe, stripe_bytes_env
+        from ..serve.objcache import cache_bytes_env
+        from ..store import (compact_dead_frac, probe,
+                             snapshot_keep_env, snapshot_records_env,
+                             stripe_bytes_env)
 
         out["knobs"] = {
             "RS_STORE_STRIPE_BYTES": stripe_bytes_env(),
             "RS_STORE_COMPACT_DEAD_FRAC": compact_dead_frac(),
+            "RS_STORE_SNAPSHOT_RECORDS": snapshot_records_env(),
+            "RS_STORE_SNAPSHOT_KEEP": snapshot_keep_env(),
+            "RS_OBJ_CACHE_BYTES": cache_bytes_env(),
             "RS_STORE_K": os.environ.get("RS_STORE_K"),
             "RS_STORE_P": os.environ.get("RS_STORE_P"),
         }
@@ -232,6 +239,8 @@ def _store_section(root: str | None = None) -> dict:
             out["dead_bytes"] += b["dead_bytes"]
             out["pending_compactions"] += b["pending_compactions"]
             out["pending_drops"] += b["pending_drops"]
+            out["snapshots"] += b.get("snapshots", 0)
+            out["segments"] += b.get("segments", 0)
     except Exception as e:  # diagnostic must never crash
         out["error"] = f"{type(e).__name__}: {e}"
     return out
@@ -729,13 +738,21 @@ def render(report: dict) -> str:
             + (f", {report['store']['pending_drops']} rolled-back "
                "record(s) pending rewrite"
                if report["store"]["pending_drops"] else "")
+            + (f", {report['store']['snapshots']} index snapshot(s) / "
+               f"{report['store']['segments']} sealed segment(s)"
+               if report["store"].get("snapshots")
+               or report["store"].get("segments") else "")
             if report["store"]["probed"]
             else (report["store"]["error"]
                   or "no root (pass --root or set RS_STORE_ROOT)")
         )
         + f"; stripe {report['store']['knobs'].get('RS_STORE_STRIPE_BYTES')} B"
           f" seal, compact @"
-          f"{report['store']['knobs'].get('RS_STORE_COMPACT_DEAD_FRAC')}",
+          f"{report['store']['knobs'].get('RS_STORE_COMPACT_DEAD_FRAC')}"
+          f", snapshot every "
+          f"{report['store']['knobs'].get('RS_STORE_SNAPSHOT_RECORDS')}"
+          f" records, obj cache "
+          f"{report['store']['knobs'].get('RS_OBJ_CACHE_BYTES')} B",
         f"[{mark(not report['strategies']['error'])}] strategies: "
         + (
             f"{'/'.join(report['strategies']['candidates'])} compete for "

@@ -195,6 +195,13 @@ def emit(req, *, status: int | None = None) -> dict | None:
         "service_s": round(req.service_s, 6),
         "error": type(req.error).__name__ if req.error else None,
     }
+    # object_get read-plane fields (serve/objcache.py): the cache
+    # verdict and the lane that produced the bytes — absent for every
+    # other op so the event schema stays lean.
+    if getattr(req, "cache", None) is not None:
+        event["cache"] = req.cache
+    if getattr(req, "path", None) is not None:
+        event["path"] = req.path
     with _RING_LOCK:
         ring = _ring()
         if ring.maxlen:

@@ -100,6 +100,15 @@ _PINNED_ENV = {
     "RS_STORE_COMPACT_DEAD_FRAC": None,
     "RS_STORE_K": None,
     "RS_STORE_P": None,
+    # Index snapshots must FIRE under the object class's torn-op
+    # schedules (a checkpoint every 32 records lands several per
+    # iteration) without moving any verdict: the snapshot plane changes
+    # how the index is reloaded, never what it says — the class digest
+    # is the proof.  An ambient disable/keep would skip or prune that
+    # coverage.
+    "RS_STORE_SNAPSHOT_RECORDS": "32",
+    "RS_STORE_SNAPSHOT_KEEP": None,
+    "RS_STORE_SNAPSHOT_DISABLE": None,
 }
 
 

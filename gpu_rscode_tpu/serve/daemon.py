@@ -77,6 +77,7 @@ from ..obs import (
     tracing as _tracing,
 )
 from ..utils.timing import PhaseTimer
+from . import objcache as _objcache
 from .batcher import Batcher
 from .queue import AdmissionQueue, Draining, QueueFull, Request
 
@@ -86,6 +87,17 @@ _COPY_CHUNK = 1024 * 1024
 DEFAULT_PORT = 9470
 DEFAULT_REQUEST_TIMEOUT_S = 300.0
 DEFAULT_MAX_BODY = 1 << 30
+DEFAULT_LIST_LIMIT = 1000
+
+
+def list_limit_env() -> int:
+    """Hard cap on one ``GET /o/<bucket>?list`` page
+    (``RS_STORE_LIST_LIMIT``, default 1000, min 1): a 10⁷-key bucket
+    never serializes whole into one response — pages chain through the
+    opaque ``next`` cursor."""
+    from ..utils.env import int_env
+
+    return max(1, int_env("RS_STORE_LIST_LIMIT", DEFAULT_LIST_LIMIT))
 
 
 def _safe_name(text: str | None, what: str) -> str:
@@ -312,15 +324,36 @@ class _Handler(BaseHTTPRequestHandler):
 
         if method == "GET" and key is None:
             # Bucket listing/report: metadata only, answered inline —
-            # it never touches the device or the stripe bytes.
+            # it never touches the device or the stripe bytes.  Listing
+            # is always paginated: one page caps at RS_STORE_LIST_LIMIT
+            # (tighter with limit=), and ``next`` carries the opaque
+            # cursor for the following page.
             try:
                 b = _store.open_bucket(tenant_root, bucket)
                 if _q1(query, "stats") == "1":
                     self._send_json(200, {"ok": True,
                                           "stats": b.stats()})
                 else:
-                    self._send_json(200, {"ok": True, "bucket": bucket,
-                                          "objects": b.list_objects()})
+                    cap = list_limit_env()
+                    raw_limit = _q1(query, "limit")
+                    if raw_limit is not None and not raw_limit.isdigit():
+                        self._send_error_json(
+                            400, f"limit= must be an integer, got "
+                            f"{raw_limit!r}")
+                        return None
+                    limit = min(cap, int(raw_limit)) if raw_limit \
+                        else cap
+                    page = b.list_page(
+                        prefix=_q1(query, "prefix") or "",
+                        limit=max(1, limit),
+                        cursor=_q1(query, "cursor"),
+                    )
+                    self._send_json(200, {
+                        "ok": True, "bucket": bucket,
+                        "objects": page["objects"],
+                        "truncated": page["truncated"],
+                        "next": page["next"],
+                    })
             except _store.ObjectNotFound as e:
                 self._send_error_json(404, str(e))
             except (_store.ObjectStoreError, OSError, ValueError) as e:
@@ -596,6 +629,12 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Type", "application/octet-stream")
             self.send_header("Content-Length", str(len(data)))
             self.send_header("X-RS-Request-Id", req.req_id)
+            # Read-plane verdicts (serve/objcache.py): which lane served
+            # the bytes — loadgen captures read these per request.
+            if req.cache is not None:
+                self.send_header("X-RS-Cache", req.cache)
+            if req.path is not None:
+                self.send_header("X-RS-Read-Path", req.path)
             if stages is not None:
                 self.send_header("X-RS-Stages", json.dumps(stages))
             self.end_headers()
@@ -659,7 +698,8 @@ class ServeDaemon:
                  workers: int | None = None,
                  request_timeout_s: float | None = None,
                  max_body: int | None = None,
-                 slo_spec: str | None = None):
+                 slo_spec: str | None = None,
+                 obj_cache_bytes: int | None = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.addr = addr if addr is not None else os.environ.get(
@@ -700,6 +740,10 @@ class ServeDaemon:
         # Per-tenant SLO objectives (obs/slo.py): RS_SLO by default,
         # --slo / slo_spec= override.  An empty engine costs nothing.
         self.slo = _slo.SLOEngine(spec=slo_spec)
+        # Hot-object read cache (serve/objcache.py): consulted before
+        # the windowed read lane on GET /o/; RS_OBJ_CACHE_BYTES caps it
+        # (0 disables — every GET reports cache=bypass).
+        self.objcache = _objcache.ObjectCache(obj_cache_bytes)
         self._trace_cm = None  # daemon-lifetime RS_TRACE session
         self._started = time.time()
         self._closed = False
@@ -931,6 +975,9 @@ class ServeDaemon:
             # view (O(archives), no log replay per scrape); buckets
             # this daemon never opened get the read-only disk probe.
             "store": self._store_block(),
+            # Hot-object read cache (serve/objcache.py): the zipf A/B's
+            # scrape target — hit-rate, resident bytes, evictions.
+            "objcache": self.objcache.stats(),
             # Lifecycle plane config (docs/SERVE.md "Request lifecycle").
             "slo": {
                 "configured": bool(self.slo.objectives),
@@ -971,6 +1018,8 @@ class ServeDaemon:
                     "live_bytes": s["live_bytes"],
                     "dead_bytes": s["dead_bytes"],
                     "index_records": s["index_records"],
+                    "index_active_records": s["index_active_records"],
+                    "open": s["open"],
                     "pending_drops": 0,  # resolved at load by contract
                     "pending_journals": 0,
                     "pending_compactions": s["pending_compactions"],
@@ -988,6 +1037,9 @@ class ServeDaemon:
             "knobs": {
                 "RS_STORE_STRIPE_BYTES": _store.stripe_bytes_env(),
                 "RS_STORE_COMPACT_DEAD_FRAC": _store.compact_dead_frac(),
+                "RS_STORE_SNAPSHOT_RECORDS":
+                    _store.snapshot_records_env(),
+                "RS_STORE_LIST_LIMIT": list_limit_env(),
             },
         }
 
@@ -1243,6 +1295,39 @@ class ServeDaemon:
         with open(req.upload, "rb") as fp:
             return fp.read()
 
+    def _object_get(self, req: Request) -> bytes:
+        """GET /o/ read plane: consult the hot-object cache BEFORE the
+        windowed read lane (caller holds the per-name lock, so the
+        verdict cannot race a same-name write).  A hit is as checked as
+        a miss — the cached location must equal the CURRENT index entry
+        and the bytes re-verify their CRC32 (serve/objcache.py); a miss
+        reads through store/readpath.py and fills the cache with the
+        exact entry it served."""
+        cache = self.objcache
+        bucket = self._object_bucket(req)
+        if not cache.enabled:
+            req.cache = "bypass"
+            info: dict = {}
+            data = bucket.get(req.key, info=info)
+            req.path = info.get("path")
+            return data
+        entry = bucket.entry_for(req.key)  # ObjectNotFound -> clean 404
+        data = cache.get(req.tenant, req.name, req.key, entry)
+        if data is not None:
+            req.cache, req.path = "hit", "cached"
+            _metrics.counter(
+                "rs_serve_device_dispatches_avoided_total",
+                "requests served without touching the device read lane",
+            ).labels(op="object_get").inc()
+            return data
+        info = {}
+        data = bucket.get(req.key, info=info)
+        req.cache, req.path = "miss", info.get("path")
+        served = info.get("entry")
+        if served is not None:
+            cache.put(req.tenant, req.name, req.key, served, data)
+        return data
+
     def _run_object_put_group(self, live: list[Request]) -> bool:
         """One put_many batch for a same-bucket PUT harvest (submission
         order; later duplicate keys win, like sequential PUTs).
@@ -1268,6 +1353,7 @@ class ServeDaemon:
                 r.group_id = None
             return False
         for r, loc in zip(ordered, locations):
+            self.objcache.invalidate(r.tenant, r.name, r.key)
             self.discard_upload(r)
             self._finish(r, "ok", result={
                 **loc, "grouped": len(ordered), "group_id": group_id})
@@ -1348,17 +1434,20 @@ class ServeDaemon:
                 elif req.op == "object_put":
                     bucket = self._object_bucket(req)
                     loc = bucket.put(req.key, self._object_payload(req))
+                    self.objcache.invalidate(req.tenant, req.name,
+                                             req.key)
                     self._mark_device_done(req, timer)
                     self.discard_upload(req)
                     self._finish(req, "ok", result=loc)
                 elif req.op == "object_get":
-                    bucket = self._object_bucket(req)
-                    data = bucket.get(req.key)
+                    data = self._object_get(req)
                     self._mark_device_done(req, timer)
                     self._finish(req, "ok", result=data)
                 elif req.op == "object_delete":
                     bucket = self._object_bucket(req)
                     out = bucket.delete(req.key)
+                    self.objcache.invalidate(req.tenant, req.name,
+                                             req.key)
                     self._mark_device_done(req, timer)
                     self._finish(req, "ok", result=out)
                 elif req.op in ("update", "append"):

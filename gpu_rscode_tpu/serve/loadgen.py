@@ -149,6 +149,15 @@ def _request_detail(payload: bytes, headers: dict,
     rid = headers.get("X-RS-Request-Id")
     if rid:
         out["req_id"] = rid
+    # object_get read-plane verdicts (serve/objcache.py): which lane
+    # served the bytes — the zipf cache A/B validator reads hit-rate
+    # straight from these capture rows.
+    cache = headers.get("X-RS-Cache")
+    if cache:
+        out["cache"] = cache
+    path = headers.get("X-RS-Read-Path")
+    if path:
+        out["path"] = path
     if json_body:
         try:
             doc = json.loads(payload)
@@ -653,6 +662,138 @@ def run_object_ab(*, files: int, object_bytes: int, k: int, p: int,
     return rows
 
 
+# -- A/B: zipf GETs with vs without the daemon object cache --------------------
+
+def run_object_cache_ab(*, objects: int, object_bytes: int, gets: int,
+                        k: int, p: int, w: int = 8, zipf: float = 1.1,
+                        trials: int = 3, cache_bytes: int | None = None,
+                        workdir: str, quiet: bool = False) -> list[dict]:
+    """The hot-object read cache, measured end to end: the SAME seeded
+    zipf GET stream over the SAME PUT corpus through two daemons — one
+    with the cache at its configured capacity, one with it disabled
+    (``obj_cache_bytes=0``, every GET pays the windowed read lane).
+    Best-of-``trials`` walls per arm (the repo's paired A/B idiom);
+    EVERY GET of EVERY trial is byte-verified against a local mirror,
+    so a wrong cached byte cannot hide inside a fast number.  A third
+    small-cap pass (capacity = 4 objects) proves the LRU actually
+    evicts under pressure.  Per-arm rows carry the verdict counts from
+    the ``X-RS-Cache`` header, the hot-key (top-decile rank) read-lane
+    avoidance rate, and the daemon's own ``objcache`` stats block."""
+    from .daemon import ServeDaemon
+    from ..obs.percentile import quantile_of
+
+    rng = random.Random(20260806)
+    payloads = {f"c{r:05d}": rng.randbytes(max(1, object_bytes))
+                for r in range(objects)}
+    weights = _zipf_weights(objects, zipf)
+    draw_rng = random.Random(20260806 ^ 0x21BF)
+    draws = [f"c{r:05d}"
+             for r in draw_rng.choices(range(objects), weights, k=gets)]
+    hot = {f"c{r:05d}" for r in range(max(1, objects // 10))}
+
+    def run_arm(arm: str, cap: int | None, arm_gets: list[str],
+                arm_trials: int) -> dict:
+        # batch_ms=0: object GETs are solo batches (queue.py shape_key),
+        # so the coalescing window is a flat latency tax on BOTH arms
+        # that drowns the read-lane delta this A/B exists to measure.
+        daemon = ServeDaemon(os.path.join(workdir, f"cab_{arm}"),
+                             port=0, obj_cache_bytes=cap, batch_ms=0)
+        daemon.start()
+        try:
+            base = f"http://127.0.0.1:{daemon.port}"
+            for key, data in payloads.items():  # corpus load — untimed
+                status, payload, _ = _post(f"{base}/o/cab/{key}", "cab",
+                                           data, method="PUT")
+                if status != 200:
+                    raise RuntimeError(
+                        f"{arm} corpus PUT {key} failed: {status} "
+                        f"{payload[-200:]!r}")
+            verdicts = {"hit": 0, "miss": 0, "bypass": 0}
+            hot_gets = hot_hits = 0
+            walls, trial_lats = [], []
+            for _ in range(max(1, arm_trials)):
+                lats = []
+                t0 = time.monotonic()
+                for key in arm_gets:
+                    t1 = time.monotonic()
+                    status, payload, hdrs = _post(
+                        f"{base}/o/cab/{key}", "cab", None, method="GET")
+                    lats.append(time.monotonic() - t1)
+                    if status != 200 or payload != payloads[key]:
+                        raise RuntimeError(
+                            f"{arm} GET {key} wrong: status {status}")
+                    verdict = hdrs.get("X-RS-Cache", "bypass")
+                    verdicts[verdict] = verdicts.get(verdict, 0) + 1
+                    if key in hot:
+                        hot_gets += 1
+                        hot_hits += verdict == "hit"
+                walls.append(time.monotonic() - t0)
+                trial_lats.append(lats)
+            lats = trial_lats[walls.index(min(walls))]
+            stats = daemon.stats().get("objcache", {})
+        finally:
+            daemon.close(drain=True, timeout=60)
+        return {
+            "kind": "object_cache_ab", "arm": arm, "objects": objects,
+            "object_bytes": object_bytes, "gets": len(arm_gets),
+            "wall_s": round(min(walls), 4),
+            "trial_walls_s": [round(wl, 4) for wl in walls],
+            "get_p50_s": round(quantile_of(lats, 0.5), 6),
+            "get_p99_s": round(quantile_of(lats, 0.99), 6),
+            "verdicts": verdicts,
+            "hot_gets": hot_gets, "hot_hits": hot_hits,
+            "hot_avoid_rate": round(hot_hits / hot_gets, 4)
+            if hot_gets else None,
+            "verified": True, "objcache": stats,
+            "config": {"k": k, "n": k + p, "w": w, "zipf": zipf,
+                       "trials": max(1, arm_trials),
+                       "cap_bytes": cap},
+        }
+
+    row_on = run_arm("cache_on", cache_bytes, draws, trials)
+    row_off = run_arm("cache_off", 0, draws, trials)
+
+    # Eviction proof: capacity for only 4 objects, one pass over a
+    # cold-heavy draw (uniform — maximal churn) MUST evict.
+    small_cap = max(1, 4 * max(1, object_bytes))
+    ev_rng = random.Random(20260806 ^ 0xE71C)
+    ev_draws = [f"c{r:05d}" for r in
+                (ev_rng.randrange(objects)
+                 for _ in range(min(gets, 4 * objects)))]
+    row_small = run_arm("cache_small", small_cap, ev_draws, 1)
+    if row_small["objcache"].get("evictions", 0) <= 0:
+        raise RuntimeError(
+            "small-cap arm recorded no evictions — LRU cap not enforced")
+
+    p50_speedup = (row_off["get_p50_s"] / row_on["get_p50_s"]
+                   if row_on["get_p50_s"] else None)
+    p99_speedup = (row_off["get_p99_s"] / row_on["get_p99_s"]
+                   if row_on["get_p99_s"] else None)
+    total_on = row_on["verdicts"]["hit"] + row_on["verdicts"]["miss"]
+    margin = {
+        "kind": "object_cache_ab_margin", "objects": objects,
+        "object_bytes": object_bytes, "gets": gets, "zipf": zipf,
+        "trials": max(1, trials),
+        "cache_on_p50_s": row_on["get_p50_s"],
+        "cache_off_p50_s": row_off["get_p50_s"],
+        "p50_speedup": round(p50_speedup, 2) if p50_speedup else None,
+        "p99_speedup": round(p99_speedup, 2) if p99_speedup else None,
+        "hit_rate": round(row_on["verdicts"]["hit"] / total_on, 4)
+        if total_on else None,
+        "hot_avoid_rate": row_on["hot_avoid_rate"],
+        "dispatches_avoided": row_on["objcache"].get("hits"),
+        "small_cap_evictions": row_small["objcache"].get("evictions"),
+    }
+    if not quiet:
+        print(f"loadgen cache A/B: p50 {row_off['get_p50_s'] * 1e3:.2f}ms "
+              f"(off) vs {row_on['get_p50_s'] * 1e3:.2f}ms (on) -> "
+              f"{p50_speedup:.1f}x, hit rate {margin['hit_rate']}, "
+              f"hot-key avoidance {margin['hot_avoid_rate']}, "
+              f"{margin['small_cap_evictions']} evictions under the "
+              f"small cap", file=sys.stderr)
+    return [row_on, row_off, row_small, margin]
+
+
 # -- CLI ----------------------------------------------------------------------
 
 def main(argv=None) -> int:
@@ -725,8 +866,19 @@ def main(argv=None) -> int:
                     help="--object-ab facade PUT batch size (default 64 "
                     "— the write-combining unit)")
     ap.add_argument("--object-trials", type=int, default=3,
-                    help="--object-ab paired trials per arm, best wall "
-                    "wins (default 3)")
+                    help="--object-ab / --object-cache-ab paired trials "
+                    "per arm, best wall wins (default 3)")
+    ap.add_argument("--object-cache-ab", action="store_true",
+                    help="A/B mode: the SAME seeded zipf GET stream "
+                    "through a daemon with the hot-object cache on vs "
+                    "off (RS_OBJ_CACHE_BYTES=0) — every GET "
+                    "byte-verified, plus a small-cap eviction proof "
+                    "(docs/SERVE.md)")
+    ap.add_argument("--object-gets", type=int, default=600,
+                    help="--object-cache-ab GETs per trial (default 600)")
+    ap.add_argument("--cache-bytes", type=int, default=None,
+                    help="--object-cache-ab cache-on arm capacity in "
+                    "bytes (default: RS_OBJ_CACHE_BYTES or 64 MiB)")
     ap.add_argument("--files", type=int, default=100,
                     help="--ab / --object-ab item count (default 100)")
     ap.add_argument("--faults", metavar="SPEC", default=None,
@@ -752,17 +904,17 @@ def main(argv=None) -> int:
         print(f"rs loadgen: need n > k > 0 (got k={args.k} n={args.n})",
               file=sys.stderr)
         return 2
-    if args.ab and args.object_ab:
-        print("rs loadgen: --ab and --object-ab conflict; pick one",
-              file=sys.stderr)
+    ab_modes = sum((args.ab, args.object_ab, args.object_cache_ab))
+    if ab_modes > 1:
+        print("rs loadgen: --ab, --object-ab and --object-cache-ab "
+              "conflict; pick one", file=sys.stderr)
         return 2
-    if not args.ab and not args.object_ab and not args.spawn \
-            and not args.url:
+    if not ab_modes and not args.spawn and not args.url:
         print("rs loadgen: pass --url or --spawn", file=sys.stderr)
         return 2
-    if args.slo and (args.ab or args.object_ab):
+    if args.slo and ab_modes:
         print("rs loadgen: --slo gates open-loop runs, not --ab/"
-              "--object-ab", file=sys.stderr)
+              "--object-ab/--object-cache-ab", file=sys.stderr)
         return 2
     if args.slo:
         from ..obs import slo as _slo
@@ -811,6 +963,16 @@ def main(argv=None) -> int:
                     trials=max(1, args.object_trials), workdir=tmp,
                     quiet=args.json)
                 mode = "object_ab"
+            elif args.object_cache_ab:
+                rows = run_object_cache_ab(
+                    objects=max(1, args.object_keys),
+                    object_bytes=args.object_bytes,
+                    gets=max(1, args.object_gets),
+                    k=args.k, p=p, w=args.w, zipf=args.object_zipf,
+                    trials=max(1, args.object_trials),
+                    cache_bytes=args.cache_bytes, workdir=tmp,
+                    quiet=args.json)
+                mode = "object_cache_ab"
             else:
                 url = args.url
                 if args.spawn:
@@ -865,8 +1027,14 @@ def main(argv=None) -> int:
                     debug = _scrape_json(url, "/debug/requests?n=200")
                     rows.append({**debug, "kind": "serve_debug_requests"})
                 if daemon is not None:
-                    rows.append({"kind": "serve_daemon_stats",
-                                 **daemon.stats()})
+                    stats = daemon.stats()
+                    rows.append({"kind": "serve_daemon_stats", **stats})
+                    if args.object_frac > 0:
+                        # Dedicated rs_obj_cache_* summary row: the zipf
+                        # cache validator reads hit-rate from the capture
+                        # alone, no /stats scrape of its own.
+                        rows.append({"kind": "obj_cache_summary",
+                                     **stats.get("objcache", {})})
                 mode = ("faulted" if args.faults
                         else "object" if args.object_frac > 0
                         else "openloop")

@@ -58,6 +58,7 @@ class Request:
         "deadline", "batch_size",
         "queue_wait_s", "service_s", "outcome", "result", "error", "done",
         "req_id", "batch_id", "group_id", "t_dispatch", "stages",
+        "cache", "path",
     )
 
     def __init__(self, op: str, tenant: str, name: str, spool: str, *,
@@ -106,6 +107,12 @@ class Request:
         self.group_id: str | None = None  # write-combined group join
         self.t_dispatch = 0.0     # execution start (service_s anchor)
         self.stages: dict | None = None
+        # object_get read-plane observability (serve/objcache.py):
+        # cache verdict (hit|miss|bypass) and the lane that produced the
+        # bytes (cached|fast|degraded) — wide-event + response-header
+        # fields, None for every other op.
+        self.cache: str | None = None
+        self.path: str | None = None
 
     def shape_key(self) -> tuple:
         """The plan-cache shape bucket this request dispatches under —

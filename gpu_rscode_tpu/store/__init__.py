@@ -20,10 +20,20 @@ from .bucket import (  # noqa: F401
     ObjectStoreError,
     cached_bucket,
     compact_dead_frac,
+    decode_cursor,
     drop_cached,
+    encode_cursor,
     list_buckets,
     open_bucket,
     probe,
     stripe_bytes_env,
 )
 from .readpath import RangeReadError, read_range  # noqa: F401
+from .snapshot import (  # noqa: F401
+    checkpoint,
+    list_segments,
+    list_snapshots,
+    load_ladder,
+    snapshot_keep_env,
+    snapshot_records_env,
+)

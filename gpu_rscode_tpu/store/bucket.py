@@ -37,10 +37,13 @@ bucket is NOT supported (the daemon serializes via its per-name lock).
 
 from __future__ import annotations
 
+import base64
+import binascii
 import json
 import os
 import re
 import threading
+import time
 import zlib
 
 import numpy as np
@@ -54,6 +57,7 @@ from ..utils.fileformat import (
     read_archive_meta,
 )
 from . import index as _index
+from . import snapshot as _snapshot
 from .readpath import RangeReadError, read_range
 
 MANIFEST_NAME = ".rs_bucket"
@@ -89,6 +93,24 @@ def compact_dead_frac() -> float:
     return min(1.0, max(1e-6, v))
 
 
+def encode_cursor(key: str) -> str:
+    """Opaque pagination cursor naming the last key a page returned."""
+    return base64.urlsafe_b64encode(key.encode()).decode().rstrip("=")
+
+
+def decode_cursor(cursor: str) -> str:
+    """The key a cursor points past; :class:`ObjectStoreError` on a
+    cursor this store never minted."""
+    try:
+        pad = "=" * (-len(cursor) % 4)
+        # validate=True: b64decode silently DISCARDS foreign characters
+        # otherwise, turning garbage cursors into (wrong) empty ones.
+        return base64.b64decode((cursor + pad).encode(),
+                                altchars=b"-_", validate=True).decode()
+    except (binascii.Error, UnicodeDecodeError, ValueError) as e:
+        raise ObjectStoreError(f"bad list cursor {cursor!r}") from e
+
+
 def _check_key(key) -> str:
     if (not isinstance(key, str) or not key or len(key) > _KEY_MAX
             or "\n" in key or "\r" in key):
@@ -122,6 +144,8 @@ class Bucket:
         self._state: _index.IndexState | None = None
         self._gens: dict[str, int] = {}
         self._totals: dict[str, int] = {}
+        self._active_records = 0
+        self._open_report: dict = {}
 
     # -- paths ---------------------------------------------------------------
 
@@ -137,13 +161,15 @@ class Bucket:
     def _load(self) -> None:
         """(Re)build the in-memory view from disk: resolve every
         archive's pending journal (the existing recovery path), read
-        post-recovery generations, replay the index log against them,
-        finish any interrupted retirement, and rewrite the log if
-        replay had to skip records — a rolled-back record must not
-        linger until later commits advance the generation past its
-        pin."""
+        post-recovery generations, rebuild the index through the
+        snapshot ladder (newest valid snapshot + tail replay —
+        O(segments), not O(puts-ever); store/snapshot.py), finish any
+        interrupted retirement, and checkpoint if replay had to skip
+        records — a rolled-back record must not linger until later
+        commits advance the generation past its pin."""
         from .. import api
 
+        t0 = time.perf_counter()
         gens: dict[str, int] = {}
         totals: dict[str, int] = {}
         for fn in sorted(os.listdir(self.path)):
@@ -162,7 +188,7 @@ class Bucket:
                     os.unlink(base)
                 except OSError:
                     pass
-        state = _index.replay(_index.read_records(self.index_file), gens)
+        state, report = _snapshot.load_ladder(self.path, gens)
         # Resume an interrupted retirement: the retire record is the
         # durable intent, the unlinks are idempotent.
         for arc in sorted(state.retired):
@@ -172,17 +198,57 @@ class Bucket:
                 totals.pop(arc, None)
             state.retired.discard(arc)
             state.dirty = True
-        if state.dirty:
-            _index.rewrite(self.index_file, state)
         self._state = state
         self._gens = gens
         self._totals = totals
+        self._active_records = report["active_records"]
+        if state.dirty:
+            # The ONE rewrite path: fold the scrubbed state into a
+            # fresh snapshot + sealed (filtered) segment.
+            self._checkpoint()
+            report = dict(report, scrubbed=True)
         self._needs_reload = False
+        report["open_seconds"] = time.perf_counter() - t0
+        report["snapshots"] = len(_snapshot.list_snapshots(self.path))
+        report["segments"] = len(_snapshot.list_segments(self.path))
+        self._open_report = report
+        _metrics.quantile(
+            "rs_store_open_seconds",
+            "bucket open wall (recovery + index ladder replay)",
+        ).observe(report["open_seconds"])
+        _metrics.counter(
+            "rs_store_open_records_replayed_total",
+            "index records replayed at bucket open",
+        ).inc(report["records_replayed"])
 
     def _ensure_loaded(self) -> _index.IndexState:
         if self._needs_reload or self._state is None:
             self._load()
         return self._state
+
+    # -- checkpointing (the ONE index rewrite path) --------------------------
+
+    def _checkpoint(self) -> dict:
+        """Fold the in-memory state into snapshot N + sealed segment N
+        (store/snapshot.py) and start a fresh active log."""
+        rep = _snapshot.checkpoint(self.path, self._state, self._gens)
+        self._active_records = 0
+        return rep
+
+    def _maybe_checkpoint(self) -> None:
+        if self._needs_reload:
+            return  # never fold a view we no longer trust
+        thresh = _snapshot.snapshot_records_env()
+        if thresh > 0 and self._active_records >= thresh:
+            self._checkpoint()
+
+    @property
+    def open_report(self) -> dict:
+        """How the last (re)load rebuilt the index: ladder source,
+        snapshots skipped, segments/records replayed, wall seconds."""
+        with self._lock:
+            self._ensure_loaded()
+            return dict(self._open_report)
 
     def _unlink_archive(self, arc: str) -> None:
         base = self._arc_path(arc)
@@ -247,6 +313,7 @@ class Bucket:
             locations.append(loc)
             offset += len(data)
         _index.append_records(self.index_file, records)
+        self._active_records += len(records)
         edits = [{"op": "append", "data": data} for _, data in items]
         try:
             summary = api.update_file_many(
@@ -262,8 +329,9 @@ class Bucket:
             # In-process failure: the group engine already rolled the
             # archive back; scrub the pre-written records out of the
             # log NOW (left in place they would validate once a later
-            # commit reaches their pinned generation).
-            _index.rewrite(self.index_file, state)
+            # commit reaches their pinned generation).  The checkpoint
+            # seal filters them out against the rolled-back generation.
+            self._checkpoint()
             raise
         if summary["generation"] != gen_next:
             # Never expected (group_edits forces one group); refuse to
@@ -313,6 +381,7 @@ class Bucket:
             locations.append(loc)
             offset += len(data)
         _index.append_records(self.index_file, records)
+        self._active_records += len(records)
         self._gens[arc] = 0
         self._totals[arc] = offset
         for (key, _), loc in zip(items, locations):
@@ -343,6 +412,7 @@ class Bucket:
             return []
         with self._lock:
             locations = self._append_batch(norm)
+            self._maybe_checkpoint()
         nbytes = sum(len(d) for _, d in norm)
         _objects_counter().labels(op="put").inc(len(norm))
         _metrics.counter(
@@ -354,7 +424,23 @@ class Bucket:
     def put(self, key: str, data) -> dict:
         return self.put_many([(key, data)])[0]
 
-    def get(self, key: str) -> bytes:
+    def entry_for(self, key: str) -> dict:
+        """The live index entry (a copy) for ``key`` — the daemon read
+        cache's validation handle: a cached object may serve only while
+        its full recorded location (arc, at, len, crc, gen) still
+        equals this."""
+        with self._lock:
+            state = self._ensure_loaded()
+            entry = state.entries.get(_check_key(key))
+            if entry is None:
+                raise ObjectNotFound(f"no object {key!r}")
+            return dict(entry)
+
+    def get(self, key: str, *, info: dict | None = None) -> bytes:
+        """Read one object.  When ``info`` is given it gains ``path``
+        (``fast``/``degraded`` — store/readpath.py) and ``entry`` (the
+        exact index entry served) for per-request observability and the
+        daemon cache's fill."""
         with self._lock:
             state = self._ensure_loaded()
             entry = state.entries.get(_check_key(key))
@@ -363,8 +449,10 @@ class Bucket:
             arcpath = self._arc_path(entry["arc"])
             data = read_range(
                 arcpath, entry["at"], entry["len"], crc=entry["crc"],
-                strategy=self.strategy,
+                strategy=self.strategy, info=info,
             )
+            if info is not None:
+                info["entry"] = dict(entry)
         _objects_counter().labels(op="get").inc()
         _metrics.counter(
             "rs_store_bytes_total", "object payload bytes moved, by op",
@@ -389,6 +477,7 @@ class Bucket:
                 {"t": "del", "key": key, "gen": self._gens.get(
                     entry["arc"], 0)},
             ])
+            self._active_records += 1
             state.drop_key(key)
             _objects_counter().labels(op="delete").inc()
             arcpath = self._arc_path(entry["arc"])
@@ -411,18 +500,52 @@ class Bucket:
                     "delete-as-update zeroing passes that failed",
                 ).inc()
                 self._needs_reload = True
+            self._maybe_checkpoint()
         self._export_gauges()
         return {"key": key, "bytes": entry["len"], "arc": entry["arc"]}
 
-    def list_objects(self) -> list[dict]:
+    def list_objects(self, *, prefix: str = "") -> list[dict]:
         with self._lock:
             state = self._ensure_loaded()
             out = [
                 {"key": key, "bytes": e["len"], "arc": e["arc"]}
                 for key, e in sorted(state.entries.items())
+                if not prefix or key.startswith(prefix)
             ]
         _objects_counter().labels(op="list").inc()
         return out
+
+    def list_page(self, *, prefix: str = "", limit: int = 0,
+                  cursor: str | None = None) -> dict:
+        """One page of the listing: keys after ``cursor`` (exclusive,
+        an opaque token a previous page's ``next`` minted) matching
+        ``prefix``, at most ``limit`` of them (<= 0: unbounded).  A
+        10⁷-key bucket never serializes whole into one response —
+        ``next`` is set iff more keys follow."""
+        start = decode_cursor(cursor) if cursor else None
+        if limit < 0:
+            limit = 0
+        with self._lock:
+            state = self._ensure_loaded()
+            keys = sorted(
+                k for k in state.entries
+                if (not prefix or k.startswith(prefix))
+                and (start is None or k > start)
+            )
+            truncated = bool(limit) and len(keys) > limit
+            if truncated:
+                keys = keys[:limit]
+            objects = [
+                {"key": k, "bytes": state.entries[k]["len"],
+                 "arc": state.entries[k]["arc"]}
+                for k in keys
+            ]
+        _objects_counter().labels(op="list").inc()
+        return {
+            "objects": objects,
+            "truncated": truncated,
+            "next": encode_cursor(keys[-1]) if truncated else None,
+        }
 
     def stat(self, key: str) -> dict:
         with self._lock:
@@ -477,12 +600,15 @@ class Bucket:
                 "live_bytes": live_total,
                 "dead_bytes": dead_total,
                 "index_records": state.records,
+                "index_active_records": self._active_records,
+                "open": dict(self._open_report),
                 "archives": archives,
                 "pending_compactions": pending,
                 "config": {
                     "k": self.k, "p": self.p, "w": self.w,
                     "stripe_bytes": self.stripe_bytes,
                     "compact_dead_frac": frac,
+                    "snapshot_records": _snapshot.snapshot_records_env(),
                 },
             }
 
@@ -537,6 +663,7 @@ class Bucket:
                 # records and committed) — NOW the old archive may die.
                 _index.append_records(self.index_file,
                                       [{"t": "retire", "arc": arc}])
+                self._active_records += 1
                 self._unlink_archive(arc)
                 self._gens.pop(arc, None)
                 self._totals.pop(arc, None)
@@ -546,9 +673,11 @@ class Bucket:
                     "stripe archives by lifecycle event",
                 ).labels(event="retired").inc()
             if retired:
-                # Hygiene rewrite: drop the superseded/retired records
-                # so the log does not grow monotonically.
-                _index.rewrite(self.index_file, state)
+                # Hygiene fold: drop the superseded/retired records so
+                # the log does not grow monotonically (the unified
+                # checkpoint path — store/snapshot.py).
+                state.retired.clear()
+                self._checkpoint()
         _metrics.counter(
             "rs_store_compactions_total", "bucket compaction passes",
         ).labels(outcome="committed" if retired else "noop").inc()
@@ -671,8 +800,7 @@ def probe(root: str) -> dict:
                 gens[os.path.basename(base)] = meta.generation
                 totals[os.path.basename(base)] = meta.total_size
                 journals += os.path.exists(journal_path(base))
-            state = _index.replay(
-                _index.read_records(_index.index_path(path)), gens)
+            state, open_report = _snapshot.load_ladder(path, gens)
             live = sum(state.live_bytes(a) for a in gens)
             total = sum(totals.values())
             frac = compact_dead_frac()
@@ -692,6 +820,9 @@ def probe(root: str) -> dict:
                 "index_records": state.records,
                 "pending_drops": (state.dropped_rolled_back
                                   + state.dropped_missing),
+                "snapshots": len(_snapshot.list_snapshots(path)),
+                "segments": len(_snapshot.list_segments(path)),
+                "open": open_report,
                 "pending_journals": journals,
                 "pending_compactions": pending,
                 "config": {"k": manifest.get("k"), "p": manifest.get("p"),
@@ -706,5 +837,7 @@ def probe(root: str) -> dict:
         "knobs": {
             "RS_STORE_STRIPE_BYTES": stripe_bytes_env(),
             "RS_STORE_COMPACT_DEAD_FRAC": compact_dead_frac(),
+            "RS_STORE_SNAPSHOT_RECORDS": _snapshot.snapshot_records_env(),
+            "RS_STORE_SNAPSHOT_KEEP": _snapshot.snapshot_keep_env(),
         },
     }

@@ -4,9 +4,10 @@
                   [--w 8|16] [--stripe-kb N]
     rs object get BUCKET KEY [--out FILE]
     rs object rm BUCKET KEY
-    rs object ls BUCKET [--json]
+    rs object ls BUCKET [--prefix P] [--limit N] [--cursor TOK] [--json]
     rs object stat BUCKET [KEY] [--json]
     rs object compact BUCKET [--force] [--json]
+    rs object openbench [--puts N --keys N ...]   (open-cost A/B)
 
 ``--root`` defaults to ``$RS_STORE_ROOT`` or ``./rs_store_root``.  The
 shape flags apply only when the bucket is created (first put); an
@@ -35,6 +36,14 @@ def main(argv=None) -> int:
         "packed into shared erasure-coded stripe archives "
         "(docs/STORE.md).",
     )
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "openbench":
+        # Its own argparse surface (bench flags, capture path) — the
+        # open-cost A/B harness, docs/STORE.md "Index snapshots".
+        from .openbench import main as _openbench_main
+
+        return _openbench_main(argv[1:])
     sub = ap.add_subparsers(dest="cmd", required=True)
 
     def common(sp, key=True):
@@ -74,6 +83,13 @@ def main(argv=None) -> int:
 
     sp = sub.add_parser("ls", help="list live objects")
     common(sp, key=False)
+    sp.add_argument("--prefix", default="",
+                    help="only keys starting with this prefix")
+    sp.add_argument("--limit", type=int, default=0,
+                    help="page size (0 = everything in one listing); "
+                    "a truncated page prints its resume cursor")
+    sp.add_argument("--cursor", default=None,
+                    help="resume token from a previous page's 'next'")
     sp.add_argument("--json", action="store_true")
 
     sp = sub.add_parser("stat", help="object index entry, or the "
@@ -135,12 +151,29 @@ def main(argv=None) -> int:
                       f"({out['bytes']} bytes tombstoned)",
                       file=sys.stderr)
         elif args.cmd == "ls":
-            objs = api.list_objects(root, args.bucket)
-            if args.json:
-                print(json.dumps(objs))
+            if args.limit or args.cursor:
+                page = api.list_objects_page(
+                    root, args.bucket, prefix=args.prefix,
+                    limit=max(0, args.limit), cursor=args.cursor)
+                if args.json:
+                    print(json.dumps(page))
+                else:
+                    for o in page["objects"]:
+                        print(f"{o['bytes']:>12}  {o['arc']}  "
+                              f"{o['key']}")
+                    if page["truncated"]:
+                        print(f"rs object: more keys follow — resume "
+                              f"with --cursor {page['next']}",
+                              file=sys.stderr)
             else:
-                for o in objs:
-                    print(f"{o['bytes']:>12}  {o['arc']}  {o['key']}")
+                objs = api.list_objects(root, args.bucket,
+                                        prefix=args.prefix)
+                if args.json:
+                    print(json.dumps(objs))
+                else:
+                    for o in objs:
+                        print(f"{o['bytes']:>12}  {o['arc']}  "
+                              f"{o['key']}")
         elif args.cmd == "stat":
             if args.key is None:
                 from . import open_bucket

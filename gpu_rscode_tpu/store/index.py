@@ -148,6 +148,17 @@ def replay(records: list[dict], generations: dict[str, int]) -> IndexState:
     POST-RECOVERY archive generations (``generations`` maps archive id
     -> metadata generation; absent id == archive files missing)."""
     st = IndexState()
+    replay_into(st, records, generations)
+    return st
+
+
+def replay_into(st: IndexState, records: list[dict],
+                generations: dict[str, int]) -> IndexState:
+    """Fold ``records`` (log order) INTO an existing state — the shared
+    core of full-log :func:`replay` and the snapshot+tail ladder
+    (store/snapshot.py): replaying a contiguous record suffix over a
+    prefix-fold is exact because records are absolute and replay is
+    last-writer-wins."""
     for rec in records:
         st.records += 1
         kind = rec["t"]
@@ -185,6 +196,12 @@ def replay(records: list[dict], generations: dict[str, int]) -> IndexState:
             "crc": int(rec["crc"]) & 0xFFFFFFFF, "gen": int(rec["gen"]),
         })
     return st
+
+
+def active_record_count(path: str) -> int:
+    """Records currently in the active log — the periodic-checkpoint
+    trigger's odometer at load time."""
+    return len(read_records(path))
 
 
 def rewrite(path: str, state: IndexState) -> None:

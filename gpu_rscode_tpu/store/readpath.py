@@ -199,6 +199,7 @@ def read_range(
     crc: int | None = None,
     strategy: str = "auto",
     segment_bytes: int = 64 * 1024 * 1024,
+    info: dict | None = None,
 ) -> bytes:
     """Bytes [at, at+length) of the archived file, reading (and — when a
     native chunk is damaged — decoding) only the touched column windows.
@@ -207,6 +208,10 @@ def read_range(
     index stores one per object): a fast-path mismatch falls through to
     the degraded reconstruction, and a degraded mismatch raises
     :class:`RangeReadError` — a range read is never silently wrong.
+
+    ``info`` (optional out-param) gains ``path``: which lane served the
+    bytes (``fast``/``degraded``) — the per-request wide event's
+    ``path`` field.
     """
     meta = read_archive_meta(metadata_file_name(file_name))
     total = meta.total_size
@@ -216,6 +221,8 @@ def read_range(
             f"{total} bytes"
         )
     if length == 0:
+        if info is not None:
+            info["path"] = "fast"
         return b""
 
     fast = (_fast_interleaved if meta.layout == "interleaved"
@@ -223,6 +230,8 @@ def read_range(
     if fast is not None and (crc is None
                              or zlib.crc32(fast) == crc & 0xFFFFFFFF):
         _read_counter().labels(path="fast").inc()
+        if info is not None:
+            info["path"] = "fast"
         return fast
 
     got = _degraded(file_name, meta, at, length,
@@ -235,4 +244,6 @@ def read_range(
             "object is damaged beyond this archive's parity"
         )
     _read_counter().labels(path="degraded").inc()
+    if info is not None:
+        info["path"] = "degraded"
     return got

@@ -477,7 +477,9 @@ def test_probe_is_readonly_and_counts_pending_drops(tmp_path,
     assert info["pending_journals"] == 1
     assert info["objects"] >= 1
     assert set(doc["knobs"]) == {"RS_STORE_STRIPE_BYTES",
-                                 "RS_STORE_COMPACT_DEAD_FRAC"}
+                                 "RS_STORE_COMPACT_DEAD_FRAC",
+                                 "RS_STORE_SNAPSHOT_RECORDS",
+                                 "RS_STORE_SNAPSHOT_KEEP"}
 
 
 def test_doctor_store_section(tmp_path, monkeypatch):
@@ -679,3 +681,417 @@ def test_loadgen_object_ab_schema(tmp_path):
     # per object, the facade a handful per stripe.
     assert margin["disk_files_per_archive"] > \
         margin["disk_files_facade"]
+
+
+# -- index snapshots + sealed segments (docs/STORE.md) ------------------------
+
+def _snap_bucket(tmp_path, monkeypatch, records="4", keep=None, **kw):
+    monkeypatch.setenv("RS_STORE_SNAPSHOT_RECORDS", records)
+    if keep is not None:
+        monkeypatch.setenv("RS_STORE_SNAPSHOT_KEEP", keep)
+    return _bucket(tmp_path, **kw)
+
+
+def test_snapshot_checkpoint_triggers_and_tail_open(tmp_path,
+                                                    monkeypatch):
+    from gpu_rscode_tpu.store import snapshot as snap
+
+    b = _snap_bucket(tmp_path, monkeypatch, records="4")
+    mirror = {}
+    for i in range(14):
+        key = f"k{i % 5}"
+        mirror[key] = bytes([i]) * (50 + i)
+        b.put(key, mirror[key])
+    bdir = os.path.join(str(tmp_path), "bkt")
+    assert snap.list_snapshots(bdir), "periodic checkpoint never fired"
+    b2 = _reload(tmp_path)
+    report = b2.open_report
+    assert report["source"] == "snapshot"
+    # O(segments) open: the tail is bounded by the trigger, not by the
+    # 14-record history.
+    assert report["records_replayed"] <= 4
+    for key, want in mirror.items():
+        assert b2.get(key) == want
+    assert {o["key"] for o in b2.list_objects()} == set(mirror)
+
+
+def test_snapshot_open_equals_full_replay(tmp_path, monkeypatch):
+    """Byte-identical state via the snapshot ladder and via
+    RS_STORE_SNAPSHOT_DISABLE=1 full replay, across overwrites,
+    deletes, a torn (rolled-back) put, and compaction."""
+    b = _snap_bucket(tmp_path, monkeypatch, records="3",
+                     keep="1000000", stripe_bytes=4 * 1024)
+    mirror = {}
+    for i in range(9):
+        key = f"k{i % 4}"
+        mirror[key] = bytes([65 + i]) * (400 + 13 * i)
+        b.put(key, mirror[key])
+    b.delete("k0")
+    del mirror["k0"]
+    monkeypatch.setenv("RS_UPDATE_CRASH", "before_commit")
+    with pytest.raises(SimulatedCrash):
+        b.put("ghost", b"g" * 256)
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    b = _reload(tmp_path)  # scrub-checkpoints the rolled-back record
+    b.compact(force=True)
+    b.put("after", b"z" * 300)
+    mirror["after"] = b"z" * 300
+
+    def state_of():
+        bb = _reload(tmp_path)
+        listing = bb.list_objects()
+        return ({o["key"] for o in listing},
+                {o["key"]: bb.get(o["key"]) for o in listing},
+                bb.open_report["source"])
+
+    keys_s, data_s, src_s = state_of()
+    monkeypatch.setenv("RS_STORE_SNAPSHOT_DISABLE", "1")
+    keys_f, data_f, src_f = state_of()
+    monkeypatch.delenv("RS_STORE_SNAPSHOT_DISABLE")
+    assert src_s == "snapshot" and src_f == "log"
+    assert keys_s == keys_f == set(mirror)
+    assert data_s == data_f == mirror
+    with pytest.raises(store.ObjectNotFound):
+        _reload(tmp_path).get("ghost")
+
+
+@pytest.mark.parametrize("damage", ["torn", "corrupt", "foreign_algo"])
+def test_snapshot_fallback_matrix(tmp_path, monkeypatch, damage):
+    """An unusable newest snapshot (truncated mid-JSON, digest
+    mismatch, foreign algo_version) falls back one rung — slower,
+    never wrong."""
+    from gpu_rscode_tpu.store import snapshot as snap
+
+    b = _snap_bucket(tmp_path, monkeypatch, records="3", keep="1000000")
+    mirror = {}
+    for i in range(11):
+        key = f"k{i % 4}"
+        mirror[key] = bytes([97 + i]) * (60 + i)
+        b.put(key, mirror[key])
+    bdir = os.path.join(str(tmp_path), "bkt")
+    snaps = snap.list_snapshots(bdir)
+    assert len(snaps) >= 2
+    newest = snap.snapshot_path(bdir, snaps[-1])
+    doc = json.load(open(newest))
+    if damage == "torn":
+        blob = open(newest).read()
+        open(newest, "w").write(blob[: len(blob) // 2])
+    elif damage == "corrupt":
+        doc["payload"]["entries"].popitem()  # digest now mismatches
+        json.dump(doc, open(newest, "w"))
+    else:
+        doc["algo_version"] = 99  # rejected BEFORE the digest check
+        doc["payload_digest"] = snap.payload_digest(doc["payload"])
+        json.dump(doc, open(newest, "w"))
+    b2 = _reload(tmp_path)
+    report = b2.open_report
+    assert report["snapshots_skipped"] >= 1
+    assert report["snapshot"] in snaps[:-1]
+    for key, want in mirror.items():
+        assert b2.get(key) == want
+
+
+def test_all_snapshots_damaged_falls_back_to_full_replay(tmp_path,
+                                                         monkeypatch):
+    from gpu_rscode_tpu.store import snapshot as snap
+
+    b = _snap_bucket(tmp_path, monkeypatch, records="3", keep="1000000")
+    mirror = {}
+    for i in range(10):
+        key = f"k{i % 3}"
+        mirror[key] = bytes([i + 1]) * 80
+        b.put(key, mirror[key])
+    bdir = os.path.join(str(tmp_path), "bkt")
+    for n in snap.list_snapshots(bdir):
+        open(snap.snapshot_path(bdir, n), "w").write("{garbage")
+    b2 = _reload(tmp_path)
+    assert b2.open_report["source"] == "log"
+    for key, want in mirror.items():
+        assert b2.get(key) == want
+
+
+def test_pruned_history_without_snapshot_fails_loud(tmp_path,
+                                                    monkeypatch):
+    """After pruning, full replay is IMPOSSIBLE (segments no longer
+    contiguous from 1) — the ladder must refuse loudly, not serve a
+    partial index."""
+    from gpu_rscode_tpu.store import snapshot as snap
+
+    b = _snap_bucket(tmp_path, monkeypatch, records="3", keep="1")
+    for i in range(14):
+        b.put(f"k{i % 3}", bytes([i + 1]) * 70)
+    bdir = os.path.join(str(tmp_path), "bkt")
+    assert snap.list_segments(bdir)[0] > 1  # pruning actually happened
+    for n in snap.list_snapshots(bdir):
+        os.unlink(snap.snapshot_path(bdir, n))
+    store.drop_cached()
+    b2 = store.open_bucket(str(tmp_path), "bkt")
+    with pytest.raises(store.ObjectStoreError, match="unrecoverable"):
+        b2.list_objects()
+
+
+def test_sealed_segments_never_resurrect(tmp_path, monkeypatch):
+    """The seal-time filter: a rolled-back record must not survive into
+    a sealed segment, so later generation advances cannot revive it
+    even on the full-replay rung."""
+    from gpu_rscode_tpu.store import index as _index
+    from gpu_rscode_tpu.store import snapshot as snap
+
+    b = _snap_bucket(tmp_path, monkeypatch, records="2", keep="1000000")
+    b.put("seed", b"s" * 128)
+    monkeypatch.setenv("RS_UPDATE_CRASH", "before_commit")
+    with pytest.raises(SimulatedCrash):
+        b.put("ghost", b"g" * 256)
+    monkeypatch.delenv("RS_UPDATE_CRASH")
+    b2 = _reload(tmp_path)       # replays the invalid record -> scrub
+    for i in range(5):           # advance generations past the pin
+        b2.put(f"fresh{i}", bytes([i + 1]) * 200)
+    bdir = os.path.join(str(tmp_path), "bkt")
+    for m in snap.list_segments(bdir):
+        for rec in _index.read_records(snap.segment_path(bdir, m)):
+            assert rec.get("key") != "ghost"
+    monkeypatch.setenv("RS_STORE_SNAPSHOT_DISABLE", "1")
+    b3 = _reload(tmp_path)
+    with pytest.raises(store.ObjectNotFound):
+        b3.get("ghost")
+    assert b3.get("fresh4") == b"\x05" * 200
+
+
+def test_open_report_schema_in_stats_and_probe(tmp_path, monkeypatch):
+    b = _snap_bucket(tmp_path, monkeypatch, records="4")
+    for i in range(9):
+        b.put(f"k{i % 3}", bytes([i + 1]) * 64)
+    b2 = _reload(tmp_path)
+    doc = b2.stats()
+    assert doc["config"]["snapshot_records"] == 4
+    assert isinstance(doc["index_active_records"], int)
+    rep = doc["open"]
+    for key in ("source", "snapshot", "snapshots_skipped",
+                "segments_replayed", "records_replayed",
+                "active_records", "open_seconds", "snapshots",
+                "segments"):
+        assert key in rep, key
+    assert rep["open_seconds"] >= 0
+    probe_doc = store.probe(str(tmp_path))
+    pb = probe_doc["buckets"]["bkt"]
+    assert pb["snapshots"] >= 1 and pb["segments"] >= 1
+    assert pb["open"]["source"] == "snapshot"
+    assert {"RS_STORE_SNAPSHOT_RECORDS",
+            "RS_STORE_SNAPSHOT_KEEP"} <= set(probe_doc["knobs"])
+
+
+# -- listing pagination -------------------------------------------------------
+
+def test_list_page_prefix_limit_cursor(tmp_path):
+    b = _bucket(tmp_path)
+    b.put_many([(f"a{i:02d}", bytes([i + 1]) * 40) for i in range(6)]
+               + [(f"b{i:02d}", bytes([i + 1]) * 40) for i in range(3)])
+    seen, cursor = [], None
+    while True:
+        page = b.list_page(prefix="a", limit=2, cursor=cursor)
+        seen += [o["key"] for o in page["objects"]]
+        if not page["truncated"]:
+            assert page["next"] is None
+            break
+        cursor = page["next"]
+    assert seen == [f"a{i:02d}" for i in range(6)]
+    full = b.list_page()
+    assert len(full["objects"]) == 9 and not full["truncated"]
+    with pytest.raises(store.ObjectStoreError):
+        b.list_page(cursor="!!!not-base64!!!")
+
+
+def test_api_list_objects_page_and_cli_ls(tmp_path, capsys):
+    from gpu_rscode_tpu.store.cli import main as object_main
+
+    root = str(tmp_path)
+    api.put_objects(root, "bkt", [(f"k{i}", b"x" * 30 + bytes([i]))
+                                  for i in range(5)], k=3, p=2)
+    page = api.list_objects_page(root, "bkt", limit=3)
+    assert [o["key"] for o in page["objects"]] == ["k0", "k1", "k2"]
+    assert page["truncated"] and page["next"]
+    page2 = api.list_objects_page(root, "bkt", limit=3,
+                                  cursor=page["next"])
+    assert [o["key"] for o in page2["objects"]] == ["k3", "k4"]
+    assert not page2["truncated"]
+    assert object_main(["ls", "bkt", "--root", root, "--limit", "3",
+                        "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert len(doc["objects"]) == 3 and doc["truncated"]
+    assert object_main(["ls", "bkt", "--root", root, "--prefix", "k4",
+                        "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert [o["key"] for o in doc] == ["k4"]
+
+
+def test_daemon_list_pagination(daemon):
+    for i in range(5):
+        s, _, _ = _call(daemon, "PUT", f"/o/pb/x{i}", b"p" * 40)
+        assert s == 200
+    s, body, _ = _call(daemon, "GET", "/o/pb?list&limit=2")
+    assert s == 200
+    doc = json.loads(body)
+    assert [o["key"] for o in doc["objects"]] == ["x0", "x1"]
+    assert doc["truncated"] and doc["next"]
+    s, body, _ = _call(daemon, "GET",
+                       f"/o/pb?list&limit=2&cursor={doc['next']}")
+    assert [o["key"] for o in json.loads(body)["objects"]] \
+        == ["x2", "x3"]
+    s, body, _ = _call(daemon, "GET", "/o/pb?list&prefix=x4")
+    assert [o["key"] for o in json.loads(body)["objects"]] == ["x4"]
+    s, _, _ = _call(daemon, "GET", "/o/pb?list&limit=abc")
+    assert s == 400
+    s, _, _ = _call(daemon, "GET", "/o/pb?list&cursor=%%%")
+    assert s == 400
+
+
+# -- the daemon hot-object read cache (serve/objcache.py) ---------------------
+
+def test_objcache_unit_lru_eviction_and_validation(tmp_path):
+    from gpu_rscode_tpu.serve.objcache import ObjectCache
+
+    c = ObjectCache(cap_bytes=250)
+    e1 = {"arc": "a1", "at": 0, "len": 100, "crc": zlib.crc32(b"x" * 100),
+          "gen": 1}
+    c.put("t", "b", "k1", e1, b"x" * 100)
+    assert c.get("t", "b", "k1", e1) == b"x" * 100
+    # A changed location tuple (overwrite) stops matching.
+    e1b = dict(e1, at=100)
+    assert c.get("t", "b", "k1", e1b) is None
+    c.put("t", "b", "k1", e1, b"x" * 100)
+    e2 = {"arc": "a1", "at": 100, "len": 200,
+          "crc": zlib.crc32(b"y" * 200), "gen": 1}
+    c.put("t", "b", "k2", e2, b"y" * 200)  # 300 > 250: k1 evicted
+    assert c.evictions >= 1
+    assert c.get("t", "b", "k1", e1) is None
+    assert c.get("t", "b", "k2", e2) == b"y" * 200
+    c.invalidate("t", "b", "k2")
+    assert c.stats()["objects"] == 0
+    disabled = ObjectCache(cap_bytes=0)
+    assert not disabled.enabled
+    disabled.put("t", "b", "k", e1, b"x" * 100)
+    assert disabled.get("t", "b", "k", e1) is None
+
+
+def test_daemon_object_cache_coherence(daemon):
+    data = b"cache-me" * 200
+    s, _, _ = _call(daemon, "PUT", "/o/cb/k", data)
+    assert s == 200
+    s, body, h = _call(daemon, "GET", "/o/cb/k")
+    assert s == 200 and body == data
+    assert h.get("X-RS-Cache") == "miss"
+    assert h.get("X-RS-Read-Path") == "fast"
+    s, body, h = _call(daemon, "GET", "/o/cb/k")
+    assert s == 200 and body == data
+    assert h.get("X-RS-Cache") == "hit"
+    assert h.get("X-RS-Read-Path") == "cached"
+    # Overwrite invalidates: next GET re-reads the NEW bytes.
+    s, _, _ = _call(daemon, "PUT", "/o/cb/k", b"v2" * 300)
+    assert s == 200
+    s, body, h = _call(daemon, "GET", "/o/cb/k")
+    assert s == 200 and body == b"v2" * 300
+    assert h.get("X-RS-Cache") == "miss"
+    s, body, h = _call(daemon, "GET", "/o/cb/k")
+    assert h.get("X-RS-Cache") == "hit" and body == b"v2" * 300
+    # Delete invalidates: a 404, never stale cached bytes.
+    s, _, _ = _call(daemon, "DELETE", "/o/cb/k")
+    assert s == 200
+    s, _, _ = _call(daemon, "GET", "/o/cb/k")
+    assert s == 404
+    st = daemon.stats()["objcache"]
+    assert st["enabled"] and st["hits"] >= 2 and st["misses"] >= 2
+    assert st["invalidations"] >= 2
+
+
+def test_daemon_object_cache_compaction_coherence(daemon):
+    """Compaction re-points live objects into fresh archives; the
+    cached location tuple stops matching, so a post-compaction GET is
+    a MISS that serves the re-pointed bytes — staleness impossible by
+    construction, even without an invalidate call."""
+    keep = b"K" * 3000
+    s, _, _ = _call(daemon, "PUT", "/o/cc/keep?k=3&n=5&stripe_kb=8",
+                    keep)
+    assert s == 200
+    for name, byte in (("dead1", b"d"), ("dead2", b"e"), ("tail", b"t")):
+        s, _, _ = _call(daemon, "PUT", f"/o/cc/{name}", byte * 3000)
+        assert s == 200  # stripe1 (keep+dead1+dead2) seals; tail opens 2
+    s, body, h = _call(daemon, "GET", "/o/cc/keep")
+    assert body == keep and h.get("X-RS-Cache") == "miss"
+    s, body, h = _call(daemon, "GET", "/o/cc/keep")
+    assert body == keep and h.get("X-RS-Cache") == "hit"
+    # Compact through the SAME process's bucket cache (daemon buckets
+    # live at <daemon.root>/<tenant>/<bucket>), bypassing the daemon's
+    # invalidation hooks entirely.
+    b = store.open_bucket(os.path.join(daemon.root, "t"), "cc")
+    b.delete("dead1")
+    b.delete("dead2")
+    out = b.compact()
+    assert out["objects_moved"] >= 1
+    s, body, h = _call(daemon, "GET", "/o/cc/keep")
+    assert s == 200 and body == keep
+    assert h.get("X-RS-Cache") == "miss"  # tuple changed, not stale
+
+
+def test_daemon_object_cache_disabled_bypasses(tmp_path):
+    from gpu_rscode_tpu.serve.daemon import ServeDaemon
+
+    d = ServeDaemon(str(tmp_path / "root"), port=0, obj_cache_bytes=0)
+    d.start()
+    try:
+        s, _, _ = _call(d, "PUT", "/o/by/k", b"z" * 500)
+        assert s == 200
+        for _ in range(2):
+            s, body, h = _call(d, "GET", "/o/by/k")
+            assert s == 200 and body == b"z" * 500
+            assert h.get("X-RS-Cache") == "bypass"
+            assert h.get("X-RS-Read-Path") == "fast"
+        assert d.stats()["objcache"]["enabled"] is False
+    finally:
+        d.close(drain=True, timeout=60)
+
+
+def test_daemon_object_cache_survives_restart_coherently(tmp_path):
+    from gpu_rscode_tpu.serve.daemon import ServeDaemon
+
+    root = str(tmp_path / "root")
+    d = ServeDaemon(root, port=0)
+    d.start()
+    try:
+        s, _, _ = _call(d, "PUT", "/o/rs/k", b"gen1" * 100)
+        assert s == 200
+        s, body, h = _call(d, "GET", "/o/rs/k")
+        assert body == b"gen1" * 100
+    finally:
+        d.close(drain=True, timeout=60)
+    store.drop_cached()
+    d2 = ServeDaemon(root, port=0)
+    d2.start()
+    try:
+        # Fresh process seam: cold cache, index reopened via the
+        # ladder; first GET is a miss with the correct bytes.
+        s, body, h = _call(d2, "GET", "/o/rs/k")
+        assert s == 200 and body == b"gen1" * 100
+        assert h.get("X-RS-Cache") == "miss"
+        s, body, h = _call(d2, "GET", "/o/rs/k")
+        assert h.get("X-RS-Cache") == "hit"
+    finally:
+        d2.close(drain=True, timeout=60)
+
+
+def test_loadgen_object_cache_ab_schema(tmp_path):
+    from gpu_rscode_tpu.serve.loadgen import run_object_cache_ab
+
+    rows = run_object_cache_ab(objects=6, object_bytes=600, gets=24,
+                               k=3, p=2, trials=1,
+                               workdir=str(tmp_path), quiet=True)
+    kinds = [r["kind"] for r in rows]
+    assert kinds == ["object_cache_ab", "object_cache_ab",
+                     "object_cache_ab", "object_cache_ab_margin"]
+    on, off, small, margin = rows
+    assert on["arm"] == "cache_on" and on["verified"]
+    assert off["arm"] == "cache_off" and off["verified"]
+    assert off["verdicts"]["bypass"] == off["gets"]
+    assert on["verdicts"]["hit"] > 0
+    assert small["objcache"]["evictions"] > 0
+    assert margin["hit_rate"] and margin["hit_rate"] > 0
+    assert margin["small_cap_evictions"] > 0
