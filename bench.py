@@ -668,6 +668,20 @@ def main() -> None:
         detail["recovery_latency_ms"] = round(1e3 * dec_dt, 2)
     except Exception as e:
         detail["decode"] = f"failed: {type(e).__name__}"
+    # Stage attribution for the headline strategy (obs/profiler.py): one
+    # extra profiled dispatch outside every timed region — where the
+    # encode wall goes (pack/chain/unpack...).  Best-effort: the bench's
+    # one JSON line must emit whether or not the profiler can run here.
+    if best[0] in ("xor", "bitplane", "table"):
+        try:
+            from gpu_rscode_tpu.tools.xor_ab import _profiled_stages
+
+            _mark("profile stages")
+            st = _profiled_stages([best[0]], A, Bd, 8)
+            if st:
+                detail["stages"] = st[best[0]]
+        except Exception:
+            pass
     _mark("done")
     _PARTIAL = (backend, best, dict(detail))  # refresh: decode keys landed
     # (backend was relabelled "tpu" above whenever the devices are real TPU

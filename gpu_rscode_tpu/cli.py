@@ -151,6 +151,14 @@ Subcommands: rs update ARCHIVE --at OFF --in DELTA [--recover] [--json]
             back to the ledger; the same ranking feeds the daemon's
             GET /health, rs_durability_* gauges and the repair
             work queue; docs/HEALTH.md)
+            rs perf [--runlog PATH] [--captures DIR] [--record]
+            [--check] [--drift-frac F] [--host H] [--backend B] [--json]
+            (per-(host,backend,strategy,op,shape-bucket) throughput
+            baselines folded from RS_PROF rs_perf dispatch events, op
+            records and bench captures; --record blesses the current
+            medians as kind=rs_perf_baseline, --check exits 4 when the
+            worst cell drifts below RS_PERF_DRIFT_FRAC (default 0.85)
+            of baseline and 2 with no evidence; docs/OBSERVABILITY.md)
             rs serve [--root DIR] [--port P] [--addr A] [--depth N]
             [--batch-ms MS] [--max-batch N] [--workers N]
             [--warm K,N[,W]] [--faults SPEC] [--slo SPEC]
@@ -643,6 +651,10 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.health import main as _health_main
 
         return _health_main(argv[1:])
+    if argv and argv[0] == "perf":
+        from .obs.perfbase import main as _perf_main
+
+        return _perf_main(argv[1:])
     if argv and argv[0] == "serve":
         from .serve.daemon import main as _serve_daemon_main
 

@@ -16,7 +16,7 @@ import jax
 import numpy as np
 
 from .models.vandermonde import generator_matrix
-from .obs import metrics as _obs_metrics
+from .obs import metrics as _obs_metrics, profiler as _prof
 from .ops.gemm import Strategy, gf_matmul_jit
 from .ops.gf import get_field
 from .ops.inverse import invert_matrix
@@ -179,6 +179,10 @@ class RSCodec:
         increment there would count TRACES, not dispatches."""
         if isinstance(data, jax.core.Tracer):
             return
+        # Profiler seam (obs/profiler.py): name the file-level op for the
+        # dispatch this call precedes, so a sampled `rs_perf` event says
+        # "decode", not "matmul".  One env read when RS_PROF is off.
+        _prof.note_op(op)
         _obs_metrics.counter(
             "segments_dispatched",
             "stripe GEMM dispatches by operation and strategy",

@@ -58,7 +58,7 @@ SCHEMA_VERSION = 1
 # always present, whatever the environment looks like.
 SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "update",
             "store", "strategies", "ledger", "metrics_endpoint", "serve",
-            "slo", "roofline", "health")
+            "slo", "roofline", "health", "perf")
 
 
 def _jax_section() -> dict:
@@ -438,6 +438,40 @@ def _health_section(ledger_records: list[dict]) -> dict:
     return out
 
 
+def _perf_section(ledger_records: list[dict]) -> dict:
+    """Perf-baseline facts (obs/perfbase.py, docs/OBSERVABILITY.md
+    "Perf attribution & baselines"): replay the shared ledger-record
+    list into the drift report — is a baseline blessed, how many cells
+    have current evidence, and how far the worst cell has drifted."""
+    out: dict = {"enabled": _runlog.enabled(), "baseline": False,
+                 "baseline_cells": 0, "current_cells": 0, "samples": 0,
+                 "worst_cell": None, "worst_ratio": None, "breach": False,
+                 "drift_frac": None,
+                 "knobs": {k: os.environ.get(k) for k in
+                           ("RS_PROF", "RS_PROF_SAMPLE",
+                            "RS_PERF_DRIFT_FRAC")},
+                 "error": None}
+    if not out["enabled"]:
+        out["error"] = "RS_RUNLOG unset (no perf evidence stream)"
+        return out
+    try:
+        from . import perfbase as _perfbase
+
+        rep = _perfbase.report(ledger_records)
+        out["baseline"] = rep["baseline"]
+        out["baseline_cells"] = rep["baseline_cells"]
+        out["current_cells"] = rep["current_cells"]
+        out["samples"] = rep["samples"]
+        out["drift_frac"] = rep["drift_frac"]
+        out["breach"] = rep["breach"]
+        if rep["worst"] is not None:
+            out["worst_cell"] = rep["worst"]["cell"]
+            out["worst_ratio"] = rep["worst"]["ratio"]
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _endpoint_section(probe: bool = True) -> dict:
     port = os.environ.get("RS_METRICS_PORT")
     out: dict = {"port": port, "reachable": None, "error": None}
@@ -622,6 +656,7 @@ def collect(probe_endpoint: bool = True,
         "slo": _slo_section(probe_endpoint),
         "roofline": _roofline_section(ledger_records),
         "health": _health_section(ledger_records),
+        "perf": _perf_section(ledger_records),
     }
     warnings = []
     if not jax_info["importable"]:
@@ -643,6 +678,11 @@ def collect(probe_endpoint: bool = True,
         warnings.append(f"{report['health']['at_risk']} archive(s) at "
                         "risk — run `rs health` for the ranked fleet "
                         "table and repair the top entries")
+    if report["perf"]["breach"]:
+        warnings.append(f"perf drift: worst cell "
+                        f"{report['perf']['worst_cell']} at "
+                        f"{report['perf']['worst_ratio']}x of baseline "
+                        "— run `rs perf` for the per-cell table")
     report["warnings"] = warnings
     return report
 
@@ -693,6 +733,25 @@ def render(report: dict) -> str:
                if h["snapshot_age_s"] is not None else "")
             + (f", {h['snapshots_corrupt']} corrupt snapshot(s) skipped"
                if h["snapshots_corrupt"] else "")
+        )
+    pf = report["perf"]
+    if not pf["enabled"] or pf["error"]:
+        perf_line = ("[--] perf: " + (pf["error"] or "unavailable")
+                     if not pf["enabled"]
+                     else f"[!!] perf: {pf['error']}")
+    else:
+        knobs = ", ".join(f"{k}={v}" for k, v in pf["knobs"].items()
+                          if v is not None) or "knobs default"
+        perf_line = (
+            f"[{mark(not pf['breach'])}] perf: "
+            + (f"baseline {pf['baseline_cells']} cell(s)"
+               if pf["baseline"] else "no blessed baseline")
+            + f", {pf['current_cells']} current, {pf['samples']} "
+              f"sample(s)"
+            + (f", worst {pf['worst_cell']} @ {pf['worst_ratio']}x "
+               f"(gate {pf['drift_frac']}x)"
+               if pf["worst_cell"] else "")
+            + f"; {knobs}"
         )
     lines = [
         f"rs doctor @ {report['host']} "
@@ -810,6 +869,7 @@ def render(report: dict) -> str:
            f"({'fresh' if rl['fresh'] else 'STALE'})"
            if rl["cached"] else "not calibrated (run rs analyze)"),
         health_line,
+        perf_line,
     ]
     for w in report.get("warnings", []):
         lines.append(f"  warning: {w}")

@@ -1,0 +1,309 @@
+"""Per-dispatch stage profiler — where ONE GEMM dispatch's wall goes.
+
+The reference CUDA tool attributed its wall per overlap stage (PCIe copy
+vs kernel, encode.cu's cudaEvent pairs); this reproduction's dispatch
+pipeline has grown far past two stages — bit-plane pack, XOR chain (or
+the ring lowering's ring-in / shift-accumulate / ring-out triple),
+unpack, plan compile, host->device staging — and ROADMAP item 1 steers
+by per-stage shares ("pack is ~60% of one-pass xor wall") that until now
+lived only in hand-run captures.  This module is the measurement seam:
+
+* **Opt-in, sampled** — ``RS_PROF`` truthy (or :func:`force_enable`)
+  turns the plane on; ``RS_PROF_SAMPLE=1/N`` profiles one dispatch in N.
+  Stage timing must ``block_until_ready`` between stages, which
+  collapses the async pack->chain overlap the pipeline exists to create
+  — the same reason ``RS_XOR_PACK_TIMING`` is opt-in — so a
+  metrics-scraping daemon samples sparsely instead of serializing every
+  dispatch.  With ``RS_PROF`` unset, :func:`begin` returns None after
+  one env read, no stage dict is allocated, and nothing registers
+  (tests/test_profiler.py guards the disabled path like
+  tests/test_reqtrace.py guards the request plane).
+* **One wide event per profiled dispatch** — op + strategy + width +
+  shape bucket, bytes moved, per-stage seconds (summing to >=95% of the
+  dispatch wall by construction: every stage is timed inside the wall),
+  and cache attribution (plan-bucket hit, PackedOperand reused vs
+  packed, schedule memory/store hit vs built, optimizer wall) — fanned
+  out to (1) the run ledger as ``kind=rs_perf`` (the ``rs perf``
+  baseline feed, dropped from ``rs history`` trend views), (2)
+  ``rs_prof_stage_seconds{stage,strategy,op}`` streaming quantiles, and
+  (3) retroactive Perfetto child spans (lane ``prof:<stage>``) under
+  PR 14's request spans, so a served request's flamegraph descends into
+  pack/chain/unpack.
+* **Thread-local** — the active profile rides thread-local state, not
+  plumbed arguments, because the seams live five layers apart
+  (codec._count_segment names the op; plan.dispatch opens the profile;
+  the pipeline __call__ deep in ops/ times the stages).  Concurrent
+  daemon workers each profile their own dispatches.
+
+Import cost: stdlib only (no jax, no numpy); jax is imported lazily and
+only while a profile is actually active.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+
+from . import metrics as _metrics, runlog as _runlog, tracing as _tracing
+
+# Canonical stage vocabulary (docs/OBSERVABILITY.md "Perf attribution &
+# baselines").  ``h2d`` (host->device staging) is observed into the same
+# quantile family but kept OUT of the per-dispatch stages dict: staging
+# happens before dispatch opens, so folding it in would break the
+# stages-sum-to-dispatch-wall invariant the capture gate asserts.
+STAGES = ("pack", "chain", "ring_in", "shift_acc", "ring_out", "unpack",
+          "compile")
+
+_TRUTHY = ("1", "true", "on", "yes")
+
+# force_enable() latch: xor_ab/bench.py profile one extra dispatch per
+# arm without asking the user to export RS_PROF.
+_FORCED = False
+
+_LOCK = threading.Lock()
+_SEEN = 0  # dispatches seen since process start — the sampling clock
+
+_TLS = threading.local()
+
+
+def enabled() -> bool:
+    """Whether the profiler plane is on: ``RS_PROF`` truthy (read per
+    call so tests can monkeypatch) or :func:`force_enable` latched."""
+    return _FORCED or os.environ.get("RS_PROF", "").lower() in _TRUTHY
+
+
+def force_enable(on: bool = True) -> None:
+    """Latch the profiler on (off) regardless of ``RS_PROF`` — the
+    in-process equivalent of exporting the env var (tools, tests)."""
+    global _FORCED
+    _FORCED = on
+
+
+def forced() -> bool:
+    """Current latch state, so tools can save/restore it."""
+    return _FORCED
+
+
+def sample_every() -> int:
+    """``RS_PROF_SAMPLE``: profile one dispatch in N (accepts ``1/N`` or
+    bare ``N``; default 1 = every dispatch).  Malformed values degrade
+    to 1 — a typo must widen observation, not silently disable it."""
+    v = os.environ.get("RS_PROF_SAMPLE", "").strip()
+    if not v:
+        return 1
+    if "/" in v:
+        v = v.split("/", 1)[1].strip()
+    try:
+        return max(1, int(v))
+    except ValueError:
+        return 1
+
+
+def _sampled() -> bool:
+    global _SEEN
+    n = sample_every()
+    with _LOCK:
+        _SEEN += 1
+        return n <= 1 or _SEEN % n == 1  # first dispatch always sampled
+
+
+def reset() -> None:
+    """Drop all thread-local + sampling state (tests)."""
+    global _SEEN
+    with _LOCK:
+        _SEEN = 0
+    for attr in ("prof", "op", "staging", "last"):
+        try:
+            delattr(_TLS, attr)
+        except AttributeError:
+            pass
+
+
+class DispatchProfile:
+    """The in-flight record of one profiled dispatch."""
+
+    __slots__ = ("op", "strategy", "w", "bucket", "bytes_in", "bytes_out",
+                 "t0", "stages", "spans", "cache", "staging_s",
+                 "staging_bytes")
+
+    def __init__(self, *, op, strategy, w, bucket, bytes_in):
+        self.op = op
+        self.strategy = strategy
+        self.w = w
+        self.bucket = bucket
+        self.bytes_in = bytes_in
+        self.bytes_out = None
+        self.t0 = time.monotonic()
+        self.stages: dict[str, float] = {}
+        self.spans: list[tuple[str, float, float]] = []
+        self.cache: dict = {}
+        self.staging_s = 0.0
+        self.staging_bytes = 0
+
+    def add(self, name: str, dt: float) -> None:
+        self.stages[name] = self.stages.get(name, 0.0) + dt
+
+
+def note_op(op: str) -> None:
+    """Name the file-level op the NEXT dispatch serves (codec seam:
+    ``_count_segment`` calls this right before ``_matmul``).  Without a
+    noted op a profiled dispatch reports ``op="matmul"``."""
+    if enabled():
+        _TLS.op = op
+
+
+def note_staging(dt: float, nbytes: int) -> None:
+    """Record one host->device staging wall (plan.stage_segment seam).
+    Held thread-locally and folded into the NEXT profile opened on this
+    thread — staging happens before its dispatch."""
+    if not enabled():
+        return
+    s, b = getattr(_TLS, "staging", (0.0, 0))
+    _TLS.staging = (s + dt, b + int(nbytes))
+
+
+def begin(*, strategy, w=None, bucket=None, bytes_in=None):
+    """Open a profile for the dispatch starting NOW, or None when the
+    plane is off / this dispatch is not sampled.  Consumes the
+    thread-local op name and any pending staging walls either way (a
+    skipped sample must not leak its staging onto a later dispatch)."""
+    if not enabled():
+        return None
+    op = getattr(_TLS, "op", None)
+    _TLS.op = None
+    staging = getattr(_TLS, "staging", None)
+    _TLS.staging = (0.0, 0)
+    # force_enable() means "profile THIS dispatch" (xor_ab's extra
+    # profiled run) — ambient RS_PROF_SAMPLE must not skip it.
+    if not _sampled() and not _FORCED:
+        return None
+    prof = DispatchProfile(op=op or "matmul", strategy=str(strategy),
+                           w=w, bucket=bucket, bytes_in=bytes_in)
+    if staging is not None:
+        prof.staging_s, prof.staging_bytes = staging
+    _TLS.prof = prof
+    return prof
+
+
+def active():
+    """The profile opened on this thread, or None (the pipeline seams'
+    one-getattr gate: disabled path costs one thread-local read)."""
+    return getattr(_TLS, "prof", None)
+
+
+def discard(prof) -> None:
+    """Drop an open profile without emitting (the dispatch raised)."""
+    if prof is not None and getattr(_TLS, "prof", None) is prof:
+        _TLS.prof = None
+
+
+def _block(out):
+    import jax
+
+    return jax.block_until_ready(out)
+
+
+def run_stage(name: str, fn, *args):
+    """Run ``fn(*args)`` as stage ``name`` of the active profile:
+    device-blocked timing + a retroactive span.  With no active profile
+    the call is forwarded untouched — callers use this unconditionally
+    only on already-profiled paths; hot paths gate on :func:`active`."""
+    prof = active()
+    if prof is None:
+        return fn(*args)
+    t0 = time.monotonic()
+    out = _block(fn(*args))
+    t1 = time.monotonic()
+    prof.add(name, t1 - t0)
+    prof.spans.append((name, t0, t1))
+    return out
+
+
+def attr(**kv) -> None:
+    """Attach cache-attribution fields to the active profile (plan
+    bucket hit/miss, PackedOperand reused/packed, schedule outcome)."""
+    prof = active()
+    if prof is not None:
+        prof.cache.update(kv)
+
+
+def add_compile(dt: float) -> None:
+    """Fold a compile wall (plan build, pipeline split-stage compile)
+    into the active profile's ``compile`` stage."""
+    prof = active()
+    if prof is not None and dt > 0:
+        prof.add("compile", dt)
+
+
+def note_opt(dt: float, **kv) -> None:
+    """Attribute one XOR-optimizer pass (ops/xor_opt.py seam): wall into
+    the cache-attribution block (it is compile-time work, not a dispatch
+    stage), plus any pass stats the optimizer reports."""
+    prof = active()
+    if prof is None:
+        return
+    prof.cache["opt_s"] = round(prof.cache.get("opt_s", 0.0) + dt, 6)
+    for k, v in kv.items():
+        prof.cache[k] = v
+
+
+def last_event() -> dict | None:
+    """The most recent wide event emitted on this thread (the
+    tools/xor_ab.py + bench.py `stages` capture hook)."""
+    return getattr(_TLS, "last", None)
+
+
+def finish(prof, out=None) -> dict | None:
+    """Close a profile: block the dispatch output, stamp the wall, fold
+    into the canonical wide event and fan it out (ledger ``kind=rs_perf``,
+    ``rs_prof_stage_seconds`` quantiles, retroactive trace spans).
+    Returns the event; None-tolerant so call sites need no guard."""
+    if prof is None:
+        return None
+    if getattr(_TLS, "prof", None) is prof:
+        _TLS.prof = None
+    if out is not None:
+        try:
+            out = _block(out)
+            prof.bytes_out = getattr(out, "nbytes", None)
+        except Exception:
+            pass  # profiling must never fail the dispatch it observes
+    wall = time.monotonic() - prof.t0
+    stages = {k: round(v, 9) for k, v in prof.stages.items() if v > 0}
+    event = {
+        "kind": "rs_perf",
+        "op": prof.op,
+        "strategy": prof.strategy,
+        "w": prof.w,
+        "bucket": prof.bucket,
+        "bytes": prof.bytes_in,
+        "bytes_out": prof.bytes_out,
+        "wall_s": round(wall, 9),
+        "stages": stages,
+        "coverage": round(sum(stages.values()) / wall, 4) if wall > 0
+        else None,
+        "cache": dict(prof.cache),
+    }
+    if prof.staging_s > 0:
+        event["staging_s"] = round(prof.staging_s, 9)
+        event["staging_bytes"] = prof.staging_bytes
+    q = _metrics.quantile(
+        "rs_prof_stage_seconds",
+        "per-dispatch stage walls (pack/chain/ring_in/shift_acc/"
+        "ring_out/unpack/compile + h2d staging), streaming quantiles",
+    )
+    for name, dt in stages.items():
+        q.labels(stage=name, strategy=prof.strategy,
+                 op=prof.op).observe(dt)
+    if prof.staging_s > 0:
+        q.labels(stage="h2d", strategy=prof.strategy,
+                 op=prof.op).observe(prof.staging_s)
+    if _tracing.active() is not None:
+        for name, t0, t1 in prof.spans:
+            _tracing.complete(name, f"prof:{name}", t0, t1,
+                              strategy=prof.strategy, op=prof.op)
+    if _runlog.enabled():
+        _runlog.record(dict(event))
+    _TLS.last = event
+    return event

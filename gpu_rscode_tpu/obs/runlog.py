@@ -200,7 +200,7 @@ def metrics_digest() -> str | None:
 # capped at half the rotation budget so a store bigger than the ledger
 # cap cannot re-trigger rotation forever.
 _PRESERVED_KINDS = ("rs_roofline", "rs_xor_schedule", "rs_autotune",
-                    "rs_health_snapshot")
+                    "rs_health_snapshot", "rs_perf_baseline")
 
 
 def _rotate(p: str, max_bytes: int) -> None:
@@ -243,6 +243,11 @@ def _rotate(p: str, max_bytes: int) -> None:
                 elif kind == "rs_health_snapshot":
                     # Fleet-wide state: one latest checkpoint, any host.
                     ident = (kind,)
+                elif kind == "rs_perf_baseline":
+                    # One blessed baseline per measurement context
+                    # (obs/perfbase.py): cells for every strategy/op/
+                    # bucket live INSIDE the record.
+                    ident = (kind, rec.get("host"), rec.get("backend"))
                 else:  # rs_roofline
                     ident = (kind, rec.get("host"))
                 latest.pop(ident, None)  # re-insert: dict order = recency
@@ -490,10 +495,14 @@ def filter_records(
     ops/xor_gemm.py + tune.py + ring_gemm.py), per-request lifecycle
     events (``rs_request``, obs/reqtrace.py — their wall includes
     queue/batch wait, so trending them as op throughput would corrupt
-    regression baselines; ``rs slo --runlog`` is their reader) and
+    regression baselines; ``rs slo --runlog`` is their reader),
     damage-plane records (``rs_damage``/``rs_health_snapshot``,
-    obs/health.py) are dropped — none of them are op measurements, and
-    they must not occupy trend-window slots or print as junk rows.
+    obs/health.py) and perf-attribution records
+    (``rs_perf``/``rs_perf_baseline``, obs/profiler.py + perfbase.py —
+    a profiled dispatch's wall includes the stage-timing blocking, so
+    trending it would poison ``--regress``; ``rs perf`` is their
+    reader) are dropped — none of them are op measurements, and they
+    must not occupy trend-window slots or print as junk rows.
 
     ``cls`` inverts the default: it selects ONE event class instead of
     the op-measurement stream — ``cls="damage"`` returns only the
@@ -518,7 +527,8 @@ def filter_records(
         if r.get("kind") in ("rs_roofline", "rs_xor_schedule",
                              "rs_autotune", "rs_ring_schedule",
                              "rs_request", "rs_damage",
-                             "rs_health_snapshot"):
+                             "rs_health_snapshot", "rs_perf",
+                             "rs_perf_baseline"):
             continue
         cfg = r.get("config") or {}
         if op is not None and op not in (

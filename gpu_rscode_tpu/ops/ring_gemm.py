@@ -66,7 +66,7 @@ from dataclasses import dataclass, replace
 import numpy as np
 
 from .gf import get_field
-from ..obs import metrics as _metrics
+from ..obs import metrics as _metrics, profiler as _prof
 from . import xor_gemm as _xg
 from .xor_gemm import (
     _COL_ALIGN, PackedOperand, matrix_digest, padded_cols, paar_cse,
@@ -581,11 +581,14 @@ def build_ring_schedule(A, w: int, cse: bool | None = None) -> RingSchedule:
     with _SCHEDULE_LOCK:
         hit = _SCHEDULE_CACHE.get(key)
     if hit is not None:
+        _prof.attr(schedule="memory")
         return hit
     loaded = _schedule_from_store(digest, bool(cse), A, w)
     if loaded is not None:
+        _prof.attr(schedule="store")
         with _SCHEDULE_LOCK:
             return _SCHEDULE_CACHE.setdefault(key, loaded)
+    _prof.attr(schedule="built")
     with _STORE_LOCK:
         _STORE_STATS["built"] += 1
     t0 = time.perf_counter()
@@ -742,7 +745,7 @@ class RingPipeline:
     __slots__ = (
         "schedule", "k", "cols", "dtype", "compile_seconds",
         "cost_analysis", "calls", "opt", "_pack", "_chain", "_unpack",
-        "_pieces", "_assemble",
+        "_pieces", "_assemble", "_emit", "_split",
     )
 
     def __init__(self, schedule: RingSchedule, k: int, cols: int, dtype):
@@ -812,6 +815,12 @@ class RingPipeline:
         self._chain = (
             jax.jit(chain_fn).lower(nodes_struct).compile()
         )
+        # The emitted (post-optimizer) schedule is retained so a profiled
+        # dispatch (obs/profiler.py) can lazily compile the three stage
+        # programs SPLIT (ring-in / shift-accumulate / ring-out) and time
+        # each; the hot path always runs the fused self._chain.
+        self._emit = emit
+        self._split = False  # False = not built; None = not splittable
         if self.opt.split_unpack:
             self._unpack = None
             self._pieces = _xg._pieces_exe(schedule.rows_out, cols, w)
@@ -823,6 +832,45 @@ class RingPipeline:
             self._pieces = self._assemble = None
         self.compile_seconds = time.perf_counter() - t0
         self.cost_analysis = self._merged_cost()
+
+    def _split_exes(self):
+        """The three ring stage programs as separate executables, built
+        on the first PROFILED dispatch (never the hot path: the fused
+        chain stays the dispatch executable).  The split is the same
+        ``_emit_slp`` composition as :func:`_ring_chain_stage` — pure
+        XOR, so outputs are byte-identical; it is not region-tiled
+        (stage walls, not cache-residency, are what it measures).
+        Returns None for degenerate schedules with no active ring
+        planes (stage 3 would have no input to shape its zeros from)."""
+        if self._split is False:
+            import jax
+
+            emit = self._emit
+            if not self.schedule.s2_planes:
+                self._split = None
+                return None
+
+            def stage_fn(pairs, rows):
+                return lambda ns: _emit_slp(ns, pairs, rows, ns[0])
+
+            t0 = time.perf_counter()
+            plane = _xg._plane_struct(self.cols)
+            split = []
+            for pairs, rows, n_in in (
+                (emit.s1_pairs, emit.s1_rows, emit.n_inputs),
+                (emit.s2_pairs, emit.s2_rows, len(emit.s1_rows)),
+                (emit.s3_pairs, emit.s3_rows, len(emit.s2_rows)),
+            ):
+                split.append(
+                    jax.jit(stage_fn(pairs, rows))
+                    .lower(tuple([plane] * n_in))
+                    .compile()
+                )
+            dt = time.perf_counter() - t0
+            self.compile_seconds += dt
+            _prof.add_compile(dt)
+            self._split = tuple(split)
+        return self._split
 
     def _merged_cost(self):
         from ..obs.attrib import extract_cost_analysis
@@ -843,6 +891,9 @@ class RingPipeline:
 
     def __call__(self, A, B):
         self.calls += 1
+        # One thread-local read: with no RS_PROF profile open this call
+        # is the unchanged fused-chain dispatch.
+        prof = _prof.active()
         if isinstance(B, PackedOperand):
             if (B.rows, B.cols, B.w) != (
                 self.k, self.cols, self.schedule.w
@@ -854,14 +905,37 @@ class RingPipeline:
                     f"{self.dtype})"
                 )
             _xg._count_pack_reuse("reused")
+            if prof is not None:
+                _prof.attr(pack="reused")
             planes = B.planes
         else:
             _xg._count_pack_reuse("packed")
-            planes = _xg._observed_pack(self._pack, B)
-        outs = self._chain(planes)
+            if prof is None:
+                planes = _xg._observed_pack(self._pack, B)
+            else:
+                _prof.attr(pack="packed")
+                planes = _prof.run_stage("pack", self._pack, B)
+        if prof is None:
+            outs = self._chain(planes)
+            if self._unpack is not None:
+                return self._unpack(outs)
+            return self._assemble(self._pieces(outs))
+        # Profiled dispatch: run the three ring stages SPLIT so each
+        # gets its own blocked wall (byte-identical to the fused chain
+        # — see _split_exes).
+        split = self._split_exes()
+        if split is None:
+            outs = _prof.run_stage("chain", self._chain, planes)
+        else:
+            s1, s2, s3 = split
+            c = _prof.run_stage("ring_in", s1, planes)
+            acc = _prof.run_stage("shift_acc", s2, c)
+            outs = _prof.run_stage("ring_out", s3, acc)
         if self._unpack is not None:
-            return self._unpack(outs)
-        return self._assemble(self._pieces(outs))
+            return _prof.run_stage("unpack", self._unpack, outs)
+        return _prof.run_stage(
+            "unpack", lambda o: self._assemble(self._pieces(o)), outs
+        )
 
     def describe(self) -> dict:
         s = self.schedule

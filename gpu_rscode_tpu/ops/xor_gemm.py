@@ -96,7 +96,7 @@ from dataclasses import dataclass, replace
 import numpy as np
 
 from .gf import get_field
-from ..obs import metrics as _metrics
+from ..obs import metrics as _metrics, profiler as _prof
 
 __all__ = [
     "XorSchedule", "XorPipeline", "PackedOperand", "build_schedule",
@@ -501,11 +501,14 @@ def build_schedule(A, w: int, cse: bool | None = None) -> XorSchedule:
     with _SCHEDULE_LOCK:
         hit = _SCHEDULE_CACHE.get(key)
     if hit is not None:
+        _prof.attr(schedule="memory")
         return hit
     loaded = _schedule_from_store(digest, bool(cse), A, w)
     if loaded is not None:
+        _prof.attr(schedule="store")
         with _SCHEDULE_LOCK:
             return _SCHEDULE_CACHE.setdefault(key, loaded)
+    _prof.attr(schedule="built")
     with _STORE_LOCK:
         _STORE_STATS["built"] += 1
     t0 = time.perf_counter()
@@ -1098,6 +1101,9 @@ class XorPipeline:
 
     def __call__(self, A, B):
         self.calls += 1
+        # One thread-local read: with no RS_PROF profile open this call
+        # is the unchanged async three-stage dispatch.
+        prof = _prof.active()
         if isinstance(B, PackedOperand):
             # Warm path: the operand was packed once by an earlier
             # consumer (docs/XOR.md) — validate the class and skip the
@@ -1112,6 +1118,8 @@ class XorPipeline:
                     f"{self.dtype})"
                 )
             _count_pack_reuse("reused")
+            if prof is not None:
+                _prof.attr(pack="reused")
             planes = B.planes
         else:
             # Pipeline-internal packs count too: the packed-vs-reused
@@ -1119,11 +1127,26 @@ class XorPipeline:
             # lands in the "packed" bucket, including the fallback
             # re-packs after a located correction drops its handle.
             _count_pack_reuse("packed")
-            planes = _observed_pack(self._pack, B)
-        outs = self._chain(planes)
+            if prof is None:
+                planes = _observed_pack(self._pack, B)
+            else:
+                _prof.attr(pack="packed")
+                planes = _prof.run_stage("pack", self._pack, B)
+        if prof is None:
+            outs = self._chain(planes)
+            if self._unpack is not None:
+                return self._unpack(outs)
+            return self._assemble(self._pieces(outs))
+        # Profiled dispatch: each stage blocked and timed (the overlap
+        # this collapses is exactly why RS_PROF is opt-in + sampled).
+        # pieces+assemble is ONE unpack stage — the split is an
+        # optimizer working-set choice, not a pipeline stage.
+        outs = _prof.run_stage("chain", self._chain, planes)
         if self._unpack is not None:
-            return self._unpack(outs)
-        return self._assemble(self._pieces(outs))
+            return _prof.run_stage("unpack", self._unpack, outs)
+        return _prof.run_stage(
+            "unpack", lambda o: self._assemble(self._pieces(o)), outs
+        )
 
     def describe(self) -> dict:
         s = self.schedule

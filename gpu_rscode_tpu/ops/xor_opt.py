@@ -49,7 +49,10 @@ separate variant instead of poisoning the cache):
 from __future__ import annotations
 
 import os
+import time
 from dataclasses import dataclass
+
+from ..obs import profiler as _prof
 
 __all__ = [
     "OptStats", "opt_enabled", "tile_override", "tile_budget_bytes",
@@ -230,8 +233,16 @@ def choose_tile(n_planes: int, nw: int, *, itemsize: int = 4):
 def optimize_program(pair_ops, rows, n_inputs: int):
     """Reorder + group one (pair_ops, rows) straight-line XOR program.
     Returns ``(pair_ops, rows, nodes_moved, term_groups)``."""
+    t0 = time.perf_counter()
     pair_ops, rows, moved = reorder_pairs(pair_ops, rows, n_inputs)
     rows, groups = group_row_terms(pair_ops, rows, n_inputs)
+    # Profiler seam (obs/profiler.py): when a profiled dispatch is
+    # compiling this program, its wide event attributes the optimizer's
+    # own wall (compile-time work, reported in the cache block) and the
+    # pass counters alongside the stage walls.  No active profile: one
+    # thread-local read.
+    _prof.note_opt(time.perf_counter() - t0, opt_moved=moved,
+                   opt_groups=groups)
     return pair_ops, rows, moved, groups
 
 

@@ -77,7 +77,8 @@ from collections import OrderedDict
 
 import numpy as np
 
-from .obs import attrib as _attrib, metrics as _metrics, tracing as _tracing
+from .obs import (attrib as _attrib, metrics as _metrics,
+                  profiler as _prof, tracing as _tracing)
 
 
 def enabled() -> bool:
@@ -194,9 +195,17 @@ def stage_segment(B, cap: int | None, retain_host: bool = True):
 
     padded = _pad_to(B, bucket_cols(B.shape[1], cap))
     host = padded if retain_host and _donation_allowed() else None
+    prof_on = _prof.enabled()
+    t0 = time.monotonic() if prof_on else 0.0
     with _tracing.span("h2d_stage", lane="stage", cols=int(B.shape[1]),
                        bucket=int(padded.shape[1])):
         staged = jax.device_put(padded)
+    if prof_on:
+        # Staging wall as the host observes it (device_put returns once
+        # the transfer is SCHEDULED on async backends — no block here,
+        # staging exists to overlap); folded into the next dispatch's
+        # profile as its h2d field (obs/profiler.py note_staging).
+        _prof.note_staging(time.monotonic() - t0, int(padded.nbytes))
     _metrics.counter(
         "rs_segments_staged_total",
         "segments bucket-padded and staged onto the device (H2D issued)",
@@ -217,8 +226,8 @@ class ExecutionPlan:
 
     __slots__ = (
         "key", "strategy", "w", "bucket", "refold", "calls", "donated_calls",
-        "compile_seconds", "cost_analysis", "xor_stats", "_compiled",
-        "_lock",
+        "compile_seconds", "cost_analysis", "xor_stats", "last_stages",
+        "_compiled", "_lock",
     )
 
     def __init__(self, key, strategy, w, bucket):
@@ -232,6 +241,7 @@ class ExecutionPlan:
         self.compile_seconds = 0.0  # lower+compile wall across all variants
         self.cost_analysis = None   # XLA cost model of one dispatch, or None
         self.xor_stats = None       # xor plans: schedule term counts
+        self.last_stages = None     # newest RS_PROF stage breakdown, or None
         self._compiled: dict = {}   # donate(bool) -> jax Compiled
         self._lock = threading.Lock()   # serializes this plan's builds
 
@@ -361,7 +371,11 @@ class ExecutionPlan:
         with self._lock:
             exe = self._compiled.get(donate)
             if exe is None:
+                t0 = time.perf_counter()
                 exe = self._compiled[donate] = self._build(A, B, donate)
+                # Cold-dispatch attribution (obs/profiler.py): the build
+                # wall is part of THIS dispatch's wall, named `compile`.
+                _prof.add_compile(time.perf_counter() - t0)
             self.calls += 1
             if donate:
                 self.donated_calls += 1
@@ -369,6 +383,11 @@ class ExecutionPlan:
             "rs_plan_dispatch_total",
             "GEMM dispatches through cached plan executables",
         ).labels(strategy=self.strategy, donated=donate).inc()
+        if self.strategy not in ("xor", "ring") and \
+                _prof.active() is not None:
+            # Monolithic strategies have one device stage; the xor/ring
+            # pipelines attribute their own pack/chain/unpack inside.
+            return _prof.run_stage("chain", exe, A, B)
         return exe(A, B)
 
     def describe(self) -> dict:
@@ -395,6 +414,11 @@ class ExecutionPlan:
             # and the matrix digest this plan is keyed by (keyed by the
             # lowering that produced it — "xor" or "ring").
             out[self.strategy] = self.xor_stats
+        if self.last_stages is not None:
+            # Newest profiled dispatch's stage walls (obs/profiler.py):
+            # where this plan's dispatch wall went, in the same stage
+            # vocabulary as `rs perf` and the xor_ab captures.
+            out["stages"] = self.last_stages
         return out
 
 
@@ -571,25 +595,49 @@ def dispatch(
                 f"plan bucket {bucket} — pack after staging, with the "
                 "same cap"
             )
+    prof = None
+    if _prof.enabled():
+        nb = getattr(B, "nbytes", None)
+        if nb is None and hasattr(B, "cols_true"):  # PackedOperand
+            nb = B.rows * B.cols_true * np.dtype(B.dtype).itemsize
+        prof = _prof.begin(strategy=strategy, w=w, bucket=int(bucket),
+                           bytes_in=int(nb) if nb else None)
+    if prof is not None:
+        misses_before = PLAN_CACHE.misses
     plan = PLAN_CACHE.lookup(key, strategy, w, bucket)
-    B = _pad_to(B, bucket)
-    if eager_fn is not None:
-        with plan._lock:
-            plan.calls += 1
-        out = eager_fn(A, B)
-    else:
-        # XLA input-output aliasing needs equal buffer sizes: the (rows, m)
-        # output can only reuse B's (k, m) buffer when rows == k (full-k
-        # decode/repair).  Encode's p < k dispatch would just compile a
-        # donate variant that warns 'donated buffers were not usable' and
-        # aliases nothing — drop the request instead.  The xor pipeline
-        # never donates: its stage split owns the intermediate planes
-        # (nor does ring, which shares the split).
-        can_alias = A.shape[0] == B.shape[0] and strategy not in (
-            "xor", "ring"
-        )
-        out = plan.run(A, B, donate and can_alias and _donation_allowed())
-    return out if bucket == m else out[:, :m]
+    if prof is not None:
+        _prof.attr(plan_bucket="miss" if PLAN_CACHE.misses > misses_before
+                   else "hit")
+    try:
+        B = _pad_to(B, bucket)
+        if eager_fn is not None:
+            with plan._lock:
+                plan.calls += 1
+            out = eager_fn(A, B)
+        else:
+            # XLA input-output aliasing needs equal buffer sizes: the
+            # (rows, m) output can only reuse B's (k, m) buffer when
+            # rows == k (full-k decode/repair).  Encode's p < k dispatch
+            # would just compile a donate variant that warns 'donated
+            # buffers were not usable' and aliases nothing — drop the
+            # request instead.  The xor pipeline never donates: its stage
+            # split owns the intermediate planes (nor does ring, which
+            # shares the split).
+            can_alias = A.shape[0] == B.shape[0] and strategy not in (
+                "xor", "ring"
+            )
+            out = plan.run(
+                A, B, donate and can_alias and _donation_allowed()
+            )
+        out = out if bucket == m else out[:, :m]
+    except BaseException:
+        _prof.discard(prof)
+        raise
+    if prof is not None:
+        event = _prof.finish(prof, out)
+        if event is not None and event.get("stages"):
+            plan.last_stages = event["stages"]
+    return out
 
 
 def dispatch_mesh(A, B, *, w: int, strategy: str, mesh, stripe_sharded, fn):

@@ -31,6 +31,9 @@ One long-lived process in front of the warm plan cache (docs/SERVE.md):
   (``?stats=1`` for the space-accounting report).
 * ``GET /healthz`` ``/metrics`` ``/stats`` — liveness JSON, Prometheus
   exposition of the live registry, queue/batcher introspection.
+* ``GET /perf`` — per-cell throughput baseline/drift report
+  (obs/perfbase.py) over the run ledger; the scrape also refreshes the
+  ``rs_perf_baseline_*`` gauges.
 
 Tenancy: ``X-RS-Tenant`` header (or ``?tenant=``) names the tenant —
 its own namespace directory under the root AND its own fairness queue
@@ -181,12 +184,18 @@ class _Handler(BaseHTTPRequestHandler):
                 # /healthz — that answers "is the daemon up", this
                 # answers "which archives are closest to data loss".
                 self._send_json(200, self.daemon.fleet_health())
+            elif url.path == "/perf":
+                # Perf-baseline drift report (obs/perfbase.py): the
+                # same per-cell table `rs perf` renders, replayed from
+                # the run ledger's rs_perf/op evidence.
+                self._send_json(200, self.daemon.perf_report())
             elif url.path == "/metrics":
                 # Rolling SLO windows age out without new traffic, so
                 # the rs_slo_* gauges refresh at scrape time — and so do
                 # scrub ages: the rs_durability_* gauges re-export too.
                 self.daemon.slo.export_gauges()
                 self.daemon.export_fleet_health()
+                self.daemon.export_perf_gauges()
                 body = _metrics.REGISTRY.render_text().encode()
                 self.send_response(200)
                 self.send_header(
@@ -938,6 +947,39 @@ class ServeDaemon:
             try:
                 _health.export_metrics(
                     _health.fleet_report(_health.load()))
+            except Exception:
+                pass  # exposition must not fail the scrape
+
+    def perf_report(self) -> dict:
+        """``GET /perf``: the per-cell throughput baseline/drift report
+        (obs/perfbase.py) replayed from the run ledger — the daemon's
+        own profiled dispatches (``RS_PROF`` sampled) feed the same
+        cells ``rs perf --check`` gates on."""
+        from ..obs import perfbase as _perfbase
+
+        if not _runlog.enabled():
+            return {
+                "kind": "rs_perf_report", "enabled": False,
+                "error": "no run ledger (start the daemon with "
+                "RS_RUNLOG set)",
+            }
+        report = _perfbase.report(_runlog.read_records(_runlog.path()))
+        report["enabled"] = True
+        _perfbase.export_gauges(report)
+        return report
+
+    def export_perf_gauges(self) -> None:
+        """Scrape-time refresh of the ``rs_perf_baseline_*`` gauges
+        (same pattern as the rs_slo_*/rs_durability_* exports: current
+        medians move as sampled dispatches land, so /metrics
+        re-derives the ratio against the blessed baseline)."""
+        if _runlog.enabled():
+            from ..obs import perfbase as _perfbase
+
+            try:
+                _perfbase.export_gauges(
+                    _perfbase.report(
+                        _runlog.read_records(_runlog.path())))
             except Exception:
                 pass  # exposition must not fail the scrape
 

@@ -96,6 +96,48 @@ def _runner(name: str, A, Bd, w: int):
     return lambda b: gf_matmul_jit(A, b, w=w, strategy=name)
 
 
+def _profiled_stages(strategies, A, Bd, w: int) -> dict:
+    """One EXTRA profiled dispatch per plan-dispatchable arm, after the
+    timed region: the stage profiler's ``block_until_ready`` between
+    stages collapses the async overlap the timed walls measure, so the
+    attribution run is a separate dispatch whose wall never enters the
+    GB/s numbers.  Two dispatches per arm — the first absorbs the plan
+    compile (the eager-entry pipelines and the plan layer cache
+    separately), the second's warm event is recorded."""
+    from .. import plan as _plan
+    from ..obs import profiler as _prof
+
+    out = {}
+    was = _prof.forced()
+    _prof.force_enable(True)
+    try:
+        for name in strategies:
+            base = name[: -len("_noopt")] if name.endswith("_noopt") \
+                else name
+            if base not in ("xor", "ring", "table", "bitplane"):
+                continue  # cpu/native/pallas do not plan-dispatch
+
+            def run(b, _s=base):
+                _prof.note_op("encode")
+                return _plan.dispatch(A, b, w=w, strategy=_s)
+
+            fn = _with_opt_off(run) if name.endswith("_noopt") else run
+            fn(Bd)  # cold: plan compile lands in this event, discarded
+            fn(Bd)
+            ev = _prof.last_event()
+            if ev is None:
+                continue
+            out[name] = {
+                k: ev[k]
+                for k in ("stages", "wall_s", "coverage", "cache",
+                          "staging_s", "staging_bytes")
+                if k in ev
+            }
+    finally:
+        _prof.force_enable(was)
+    return out
+
+
 def run_ab(
     *,
     size_mb: float,
@@ -175,6 +217,10 @@ def run_ab(
         "xor_over_table": speedup,
         "opt_speedup": opt_speedup,
         "ring_over_xor": ring_over_xor,
+        # Per-arm stage attribution (obs/profiler.py) from one extra
+        # profiled dispatch outside the timed region — where each arm's
+        # wall goes (pack/chain/unpack; ring_in/shift_acc/ring_out).
+        "stages": _profiled_stages(list(runners), A, Bd, w),
     }
     if not quiet:
         detail = "  ".join(f"{n}={g} GB/s" for n, g in gbps.items())
@@ -186,6 +232,15 @@ def run_ab(
             + (f"  ring/xor {ring_over_xor}x" if ring_over_xor else ""),
             file=sys.stderr,
         )
+        for name, ev in row["stages"].items():
+            shares = "  ".join(
+                f"{s}={dt / ev['wall_s'] * 100:.0f}%"
+                for s, dt in sorted(ev["stages"].items(),
+                                    key=lambda kv: -kv[1])
+            )
+            print(f"xor_ab:   {name} stages ({ev['wall_s'] * 1e3:.1f}ms "
+                  f"profiled, coverage {ev['coverage']}): {shares}",
+                  file=sys.stderr)
     return [row]
 
 

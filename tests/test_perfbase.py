@@ -1,0 +1,277 @@
+"""Perf baselines + drift sentinel (obs/perfbase.py): sample folding
+from all three evidence streams, shape-bucketing, baseline-record
+validation (algo_version before digest), the bless/check lifecycle with
+its exit-code gate (4 = drift, 2 = no evidence), gauge export and the
+doctor section (docs/OBSERVABILITY.md "Perf attribution & baselines").
+"""
+
+import json
+import socket
+
+import pytest
+
+from gpu_rscode_tpu import cli
+from gpu_rscode_tpu.obs import metrics, perfbase, runlog
+
+HOST = socket.gethostname()
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    monkeypatch.delenv("RS_PERF_DRIFT_FRAC", raising=False)
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    yield
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def _perf(gbps, n=6, ts0=1000.0, strategy="xor", op="encode",
+          nbytes=16 << 20, host=HOST, backend="cpu", stages=None):
+    return [{"kind": "rs_perf", "op": op, "strategy": strategy,
+             "bytes": nbytes, "wall_s": nbytes / (gbps * 1e9),
+             "stages": stages or {"pack": 0.004}, "host": host,
+             "backend": backend, "ts": ts0 + i} for i in range(n)]
+
+
+def _write(path, recs):
+    with open(path, "w") as fp:
+        for r in recs:
+            fp.write(json.dumps(r) + "\n")
+
+
+# ----- folding ---------------------------------------------------------------
+
+def test_bucket_label_powers_of_two():
+    assert perfbase.bucket_label(1) == "1B"
+    assert perfbase.bucket_label(4096) == "4KiB"
+    assert perfbase.bucket_label(4097) == "8KiB"
+    assert perfbase.bucket_label(16 << 20) == "16MiB"
+    assert perfbase.bucket_label(0) is None
+    assert perfbase.bucket_label(None) is None
+    assert perfbase.bucket_label(-5) is None
+
+
+def test_collect_samples_folds_all_three_streams():
+    recs = (
+        _perf(2.0, n=2)
+        + [{"kind": "rs_run", "op": "encode_file",
+            "config": {"strategy": "ring"}, "bytes": 8 << 20,
+            "wall_s": (8 << 20) / 1.5e9, "outcome": "ok", "host": HOST,
+            "backend": "cpu", "ts": 1100.0}]
+        + [{"kind": "capture_header", "tool": "xor_ab", "host": HOST,
+            "backend": "cpu", "ts": 1200.0},
+           {"kind": "xor_ab", "op": "encode", "bytes": 20 << 20,
+            "gbps": {"xor": 0.75, "table": 0.15}}]
+    )
+    samples = perfbase.collect_samples(recs)
+    cells = {perfbase.cell_key(s["strategy"], s["op"], s["bucket"])
+             for s in samples}
+    assert cells == {
+        "xor|encode|16MiB", "ring|encode_file|8MiB",
+        "xor|encode|32MiB", "table|encode|32MiB",
+    }
+    # Capture rows inherit host/backend/ts from their header.
+    ab = [s for s in samples if s["bucket"] == "32MiB"]
+    assert all(s["host"] == HOST and s["backend"] == "cpu"
+               and s["ts"] == 1200.0 for s in ab)
+
+
+def test_collect_samples_excludes_cold_and_broken_evidence():
+    recs = (
+        # compile-dominated profiled dispatch: a compile measurement
+        _perf(2.0, n=1, stages={"compile": 1.25})
+        # errored op record: throughput_gbps refuses it
+        + [{"kind": "rs_run", "op": "encode",
+            "config": {"strategy": "xor"}, "bytes": 1 << 20,
+            "wall_s": 0.001, "outcome": "error", "host": HOST,
+            "backend": "cpu", "ts": 1.0}]
+        # strategy-less op record cannot form a cell
+        + [{"kind": "rs_run", "op": "encode", "config": {},
+            "bytes": 1 << 20, "wall_s": 0.001, "outcome": "ok",
+            "host": HOST, "backend": "cpu", "ts": 2.0}]
+    )
+    recs[0]["wall_s"] = 1.3
+    assert perfbase.collect_samples(recs) == []
+
+
+def test_current_cells_median_of_newest_window():
+    samples = perfbase.collect_samples(
+        _perf(1.0, n=3, ts0=1000.0) + _perf(3.0, n=3, ts0=2000.0))
+    cells = perfbase.current_cells(samples, HOST, "cpu", window=3)
+    cell = cells["xor|encode|16MiB"]
+    assert cell["gbps"] == pytest.approx(3.0)  # newest 3 only
+    assert cell["n"] == 6 and cell["ts"] == 2002.0
+    # Other hosts' samples never leak into this host's cells.
+    assert perfbase.current_cells(samples, "elsewhere", "cpu") == {}
+
+
+# ----- baseline records ------------------------------------------------------
+
+def test_valid_baseline_checks_algo_version_before_digest():
+    cells = {"xor|encode|16MiB": {"gbps": 2.0, "n": 6, "ts": 1.0}}
+    good = {"kind": "rs_perf_baseline",
+            "algo_version": perfbase.ALGO_VERSION, "host": HOST,
+            "backend": "cpu", "cells": cells,
+            "payload_digest": perfbase.payload_digest(cells)}
+    assert perfbase.valid_baseline(good)
+    assert not perfbase.valid_baseline({**good, "algo_version": 99})
+    assert not perfbase.valid_baseline(
+        {**good, "payload_digest": "0" * 16})
+    assert not perfbase.valid_baseline({**good, "cells": {}})
+    assert not perfbase.valid_baseline({**good, "kind": "rs_run"})
+
+
+def test_load_baseline_takes_newest_valid_per_context(tmp_path):
+    cells_a = {"xor|encode|16MiB": {"gbps": 2.0, "n": 6, "ts": 1.0}}
+    cells_b = {"xor|encode|16MiB": {"gbps": 4.0, "n": 6, "ts": 2.0}}
+    mk = lambda c: {"kind": "rs_perf_baseline",
+                    "algo_version": perfbase.ALGO_VERSION, "host": HOST,
+                    "backend": "cpu", "cells": c,
+                    "payload_digest": perfbase.payload_digest(c)}
+    corrupt = {**mk(cells_b), "payload_digest": "beef"}
+    recs = [mk(cells_a), mk(cells_b), corrupt]
+    got = perfbase.load_baseline(recs, HOST, "cpu")
+    assert got["cells"] == cells_b  # newest VALID wins; corrupt ignored
+    assert perfbase.load_baseline(recs, "elsewhere", "cpu") is None
+
+
+def test_bless_carries_unobserved_prior_cells(tmp_path):
+    ledger = str(tmp_path / "run.jsonl")
+    _write(ledger, _perf(2.0))
+    rec1 = perfbase.bless(ledger, runlog.read_records(ledger), HOST,
+                          "cpu")
+    assert set(rec1["cells"]) == {"xor|encode|16MiB"}
+    # New evidence for a DIFFERENT cell only: re-bless keeps the old one.
+    with open(ledger, "a") as fp:
+        for r in _perf(1.5, strategy="ring", ts0=3000.0):
+            fp.write(json.dumps(r) + "\n")
+    rec2 = perfbase.bless(ledger, runlog.read_records(ledger), HOST,
+                          "cpu")
+    assert set(rec2["cells"]) == {"xor|encode|16MiB",
+                                  "ring|encode|16MiB"}
+    assert perfbase.valid_baseline(rec2)
+    # The blessed record persisted crash-atomically into the ledger.
+    stored = perfbase.load_baseline(runlog.read_records(ledger), HOST,
+                                    "cpu")
+    assert stored["cells"] == rec2["cells"]
+
+
+# ----- the drift gate --------------------------------------------------------
+
+def test_rs_perf_check_lifecycle_and_exit_codes(tmp_path, capsys,
+                                                monkeypatch):
+    ledger = str(tmp_path / "run.jsonl")
+    _write(ledger, _perf(2.0) + _perf(1.5, strategy="ring", op="encode"))
+    # No baseline blessed: no evidence is not a pass.
+    assert cli.main(["perf", "--runlog", ledger, "--check"]) == 2
+    assert "INCONCLUSIVE" in capsys.readouterr().err
+    # Bless, then the honest numbers pass.
+    assert cli.main(["perf", "--runlog", ledger, "--record"]) == 0
+    capsys.readouterr()
+    assert cli.main(["perf", "--runlog", ledger, "--check"]) == 0
+    assert "CHECK OK" in capsys.readouterr().err
+    # A >=25% synthetic regression on the xor cell trips the gate and
+    # the breach names the worst cell.
+    with open(ledger, "a") as fp:
+        for r in _perf(1.0, ts0=5000.0, n=8):
+            fp.write(json.dumps(r) + "\n")
+    assert cli.main(["perf", "--runlog", ledger, "--check"]) == 4
+    err = capsys.readouterr().err
+    assert "DRIFT BREACH" in err and "xor|encode|16MiB" in err
+    # The knob loosens the gate (env and flag spellings agree).
+    monkeypatch.setenv("RS_PERF_DRIFT_FRAC", "0.4")
+    assert cli.main(["perf", "--runlog", ledger, "--check"]) == 0
+    monkeypatch.delenv("RS_PERF_DRIFT_FRAC")
+    assert cli.main(["perf", "--runlog", ledger, "--check",
+                     "--drift-frac", "0.4"]) == 0
+    # Re-blessing the degraded numbers resets the gate.
+    capsys.readouterr()
+    assert cli.main(["perf", "--runlog", ledger, "--record"]) == 0
+    assert cli.main(["perf", "--runlog", ledger, "--check"]) == 0
+
+
+def test_rs_perf_cli_errors(tmp_path, capsys, monkeypatch):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    assert cli.main(["perf"]) == 2  # no ledger configured
+    assert "RS_RUNLOG" in capsys.readouterr().err
+    assert cli.main(["perf", "--runlog",
+                     str(tmp_path / "missing.jsonl")]) == 1
+    ledger = str(tmp_path / "empty.jsonl")
+    _write(ledger, [])
+    assert cli.main(["perf", "--runlog", ledger, "--record"]) == 2
+    assert "nothing to bless" in capsys.readouterr().err
+
+
+def test_rs_perf_folds_bench_captures(tmp_path, capsys):
+    ledger = str(tmp_path / "run.jsonl")
+    _write(ledger, _perf(2.0))
+    cap = tmp_path / "caps" / "xor_ab_cpu_1.jsonl"
+    cap.parent.mkdir()
+    _write(str(cap), [
+        {"kind": "capture_header", "tool": "xor_ab", "host": HOST,
+         "backend": "cpu", "ts": 2000.0},
+        {"kind": "xor_ab", "op": "encode", "bytes": 20 << 20,
+         "gbps": {"xor": 0.75, "ring": 0.8}},
+    ])
+    assert cli.main(["perf", "--runlog", ledger, "--captures",
+                     str(cap.parent), "--json"]) == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert {r["cell"] for r in rep["rows"]} == {
+        "xor|encode|16MiB", "xor|encode|32MiB", "ring|encode|32MiB"}
+
+
+# ----- exposition ------------------------------------------------------------
+
+def test_export_gauges_mirror_the_report():
+    metrics.force_enable()
+    baseline_cells = {
+        "xor|encode|16MiB": {"gbps": 2.0, "n": 6, "ts": 1.0}}
+    rep = {
+        "rows": [{"cell": "xor|encode|16MiB", "strategy": "xor",
+                  "op": "encode", "bucket": "16MiB", "base_gbps": 2.0,
+                  "cur_gbps": 1.0, "n": 8, "ratio": 0.5,
+                  "status": "drift"}],
+        "baseline_cells": len(baseline_cells), "breach": True,
+    }
+    perfbase.export_gauges(rep)
+    snap = metrics.REGISTRY.snapshot()
+    key = '{bucket="16MiB",op="encode",strategy="xor"}'
+    assert snap["rs_perf_baseline_gbps"]["values"][key] == 2.0
+    assert snap["rs_perf_baseline_current_gbps"]["values"][key] == 1.0
+    assert snap["rs_perf_baseline_ratio"]["values"][key] == 0.5
+    assert snap["rs_perf_baseline_breach"]["values"][""] == 1
+    # Disabled metrics: the export is a silent no-op.
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+    perfbase.export_gauges(rep)
+    assert metrics.REGISTRY.names() == []
+
+
+def test_doctor_perf_section(tmp_path, capsys, monkeypatch):
+    ledger = str(tmp_path / "run.jsonl")
+    _write(ledger, _perf(2.0) + _perf(1.0, ts0=5000.0, n=8))
+    perfbase.bless(
+        ledger,
+        [r for r in runlog.read_records(ledger) if r["ts"] < 5000.0],
+        HOST, "cpu")
+    monkeypatch.setenv("RS_RUNLOG", ledger)
+    assert cli.main(["doctor", "--json", "--no-probe"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    sec = report["perf"]
+    assert sec["enabled"] and sec["baseline"]
+    assert sec["baseline_cells"] == 1 and sec["current_cells"] == 1
+    assert sec["worst_cell"] == "xor|encode|16MiB"
+    assert sec["worst_ratio"] == pytest.approx(0.5)
+    assert sec["breach"] is True
+    assert any("perf drift" in w for w in report["warnings"])
+    assert cli.main(["doctor", "--no-probe"]) == 0
+    out = capsys.readouterr().out
+    assert "[!!] perf:" in out and "xor|encode|16MiB" in out
+    # Unset ledger: schema-stable disabled section, [--] line.
+    monkeypatch.delenv("RS_RUNLOG")
+    assert cli.main(["doctor", "--json", "--no-probe"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert report["perf"]["enabled"] is False
+    assert set(report) >= set(report["perf"].keys() & set())  # schema keys
+    for key in ("baseline", "worst_cell", "breach", "knobs", "error"):
+        assert key in report["perf"]

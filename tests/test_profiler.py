@@ -1,0 +1,183 @@
+"""Dispatch-stage profiler (obs/profiler.py): the disabled-path guard
+(RS_PROF unset = no stage dicts, no registries touched, byte-identical
+outputs), the per-dispatch wide event and its stage/cache attribution,
+1/N sampling, and the ledger fan-out with its `rs history` drop
+(docs/OBSERVABILITY.md "Perf attribution & baselines").
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import plan
+from gpu_rscode_tpu.models.vandermonde import vandermonde_matrix
+from gpu_rscode_tpu.obs import metrics, profiler, runlog
+from gpu_rscode_tpu.ops.gf import get_field
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane(monkeypatch):
+    monkeypatch.delenv("RS_PROF", raising=False)
+    monkeypatch.delenv("RS_PROF_SAMPLE", raising=False)
+    monkeypatch.delenv("RS_METRICS", raising=False)
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    profiler.force_enable(False)
+    profiler.reset()
+    yield
+    profiler.force_enable(False)
+    profiler.reset()
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+
+
+def _stripe(w, m=4096, k=5, p=3, seed=20260804):
+    import jax
+
+    gf = get_field(w)
+    A = vandermonde_matrix(p, k, gf)
+    rng = np.random.default_rng(seed)
+    B = rng.integers(0, gf.size, size=(k, m)).astype(gf.dtype)
+    return A, jax.device_put(B)
+
+
+def _dispatch(strategy, w=8, m=4096):
+    A, Bd = _stripe(w, m)
+    return np.asarray(plan.dispatch(A, Bd, w=w, strategy=strategy))
+
+
+# ----- disabled-path guard (tier-1) ------------------------------------------
+
+def test_disabled_plane_allocates_nothing_and_registers_nothing():
+    """With RS_PROF unset and not forced: begin() returns None after one
+    env read, the pipelines take their unprofiled branches, no profile
+    rides the thread, no event is held, and the rs_prof_* quantile
+    family never registers — the reqtrace disabled-path contract."""
+    assert not profiler.enabled()
+    assert profiler.begin(strategy="xor") is None
+    _dispatch("xor")
+    _dispatch("ring")
+    assert profiler.active() is None
+    assert profiler.last_event() is None
+    assert "rs_prof_stage_seconds" not in metrics.REGISTRY.names()
+    # Seams are no-ops too, not errors, when nothing is active.
+    profiler.note_op("encode")
+    profiler.note_staging(0.1, 100)
+    profiler.attr(pack="reused")
+    profiler.add_compile(0.5)
+    assert profiler.finish(None) is None
+    assert profiler.active() is None
+
+
+@pytest.mark.parametrize("strategy", ["xor", "ring"])
+@pytest.mark.parametrize("w", [8, 16])
+def test_profiled_output_byte_identical(strategy, w):
+    """The profiler's split-stage execution (blocked stage boundaries,
+    the ring pipeline's three-program split) must not change a single
+    byte of the dispatch result, either width."""
+    off = _dispatch(strategy, w=w)
+    profiler.force_enable(True)
+    on = _dispatch(strategy, w=w)
+    assert on.dtype == off.dtype and np.array_equal(on, off)
+
+
+# ----- the wide event --------------------------------------------------------
+
+def test_event_stages_cover_the_wall_and_attribute_caches():
+    profiler.force_enable(True)
+    metrics.force_enable(True)
+    _dispatch("xor")          # cold: compile lands in this event
+    _dispatch("xor")          # warm: pure stage walls
+    ev = profiler.last_event()
+    assert ev["kind"] == "rs_perf" and ev["strategy"] == "xor"
+    assert ev["op"] == "matmul" and ev["w"] == 8
+    assert ev["bytes"] > 0 and ev["bytes_out"] > 0
+    assert set(ev["stages"]) <= set(profiler.STAGES)
+    assert {"pack", "chain", "unpack"} <= set(ev["stages"])
+    assert "compile" not in ev["stages"]  # warm dispatch
+    # Stage walls sum to the dispatch wall (every stage is timed inside
+    # it); Python glue is the only gap.
+    assert 0.5 <= ev["coverage"] <= 1.0
+    assert abs(sum(ev["stages"].values()) / ev["wall_s"]
+               - ev["coverage"]) < 1e-3
+    cache = ev["cache"]
+    assert cache["plan_bucket"] == "hit"   # second dispatch, warm plan
+    assert cache["pack"] == "packed"
+    # Schedule attribution appears only on dispatches that LOOK UP a
+    # schedule (pipeline construction) — a warm pipeline skips it.
+    assert cache.get("schedule") in (None, "memory", "store", "built")
+    # The quantile family registered per stage.
+    snap = metrics.REGISTRY.snapshot()["rs_prof_stage_seconds"]["values"]
+    assert any('stage="pack"' in k for k in snap)
+    assert any('stage="chain"' in k for k in snap)
+
+
+def test_ring_event_splits_the_ring_stages():
+    profiler.force_enable(True)
+    _dispatch("ring")
+    _dispatch("ring")
+    ev = profiler.last_event()
+    assert ev["strategy"] == "ring"
+    assert {"ring_in", "shift_acc", "ring_out"} <= set(ev["stages"])
+    assert "chain" not in ev["stages"]
+    assert ev["cache"]["plan_bucket"] == "hit"
+
+
+def test_cold_dispatch_attributes_compile():
+    profiler.force_enable(True)
+    _dispatch("table", m=2048)
+    ev = profiler.last_event()
+    assert ev["stages"].get("compile", 0) > 0
+
+
+def test_noted_op_names_the_next_dispatch_only():
+    profiler.force_enable(True)
+    profiler.note_op("decode")
+    _dispatch("table", m=2048)
+    assert profiler.last_event()["op"] == "decode"
+    _dispatch("table", m=2048)
+    assert profiler.last_event()["op"] == "matmul"  # consumed, not sticky
+
+
+# ----- sampling --------------------------------------------------------------
+
+def test_sample_every_parses_both_spellings(monkeypatch):
+    assert profiler.sample_every() == 1
+    monkeypatch.setenv("RS_PROF_SAMPLE", "1/8")
+    assert profiler.sample_every() == 8
+    monkeypatch.setenv("RS_PROF_SAMPLE", "4")
+    assert profiler.sample_every() == 4
+    monkeypatch.setenv("RS_PROF_SAMPLE", "nope")
+    assert profiler.sample_every() == 1  # malformed widens, not disables
+
+
+def test_sampling_profiles_one_in_n(tmp_path, monkeypatch):
+    ledger = tmp_path / "ledger.jsonl"
+    monkeypatch.setenv("RS_RUNLOG", str(ledger))
+    monkeypatch.setenv("RS_PROF", "1")
+    monkeypatch.setenv("RS_PROF_SAMPLE", "1/3")
+    profiler.reset()
+    for _ in range(6):
+        _dispatch("table", m=2048)
+    recs = runlog.read_records(str(ledger))
+    perf = [r for r in recs if r.get("kind") == "rs_perf"]
+    assert len(perf) == 2  # dispatches 1 and 4 of 6
+    # The identity envelope rode along, like every ledger record.
+    assert perf[0]["run"] == runlog.run_id() and perf[0]["host"]
+    # ...and the trend view never sees profiled walls (their stage
+    # blocking poisons throughput trends — rs perf is their reader).
+    assert runlog.filter_records(recs) == []
+    assert all(json.dumps(r) for r in recs)
+
+
+def test_error_dispatch_discards_the_profile():
+    profiler.force_enable(True)
+    A, Bd = _stripe(8)
+
+    def boom(a, b):
+        raise RuntimeError("injected dispatch failure")
+
+    with pytest.raises(RuntimeError):
+        plan.dispatch(A, Bd, w=8, strategy="table", eager_fn=boom)
+    assert profiler.active() is None  # discarded, no half-open profile
+    profiler.force_enable(False)

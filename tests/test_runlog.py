@@ -115,6 +115,39 @@ def test_rotation_keeps_one_generation(tmp_path, ledger, monkeypatch):
     assert [r["i"] for r in recs] == sorted(r["i"] for r in recs)
 
 
+def test_rotation_preserves_perf_baseline(tmp_path, ledger, monkeypatch):
+    """A blessed ``rs_perf_baseline`` record is calibration state, not
+    history: rotation must carry the newest one per (host, backend)
+    into the fresh generation (like rs_autotune / rs_health_snapshot),
+    deduped newest-first."""
+    from gpu_rscode_tpu.obs import perfbase
+
+    def baseline(gbps, ts):
+        cells = {"xor|encode|16MiB": {"gbps": gbps, "n": 6, "ts": ts}}
+        return {"kind": "rs_perf_baseline",
+                "algo_version": perfbase.ALGO_VERSION,
+                "host": "h1", "backend": "cpu", "cells": cells,
+                "payload_digest": perfbase.payload_digest(cells)}
+
+    runlog.record(baseline(2.0, 1.0), ledger)
+    runlog.record(baseline(3.0, 2.0), ledger)  # newer bless, same cell
+    # Cap chosen so rotation fires but the carry budget (half the cap)
+    # still fits one blessed record.
+    monkeypatch.setenv("RS_RUNLOG_MAX_BYTES", "2000")
+    for i in range(30):
+        runlog.record({"op": "encode", "i": i}, ledger)
+    assert os.path.exists(ledger + ".1")
+    # The FRESH generation got exactly one carried copy per context
+    # (the stale h1 bless was deduped away), intact and loadable.
+    live = runlog.read_records(ledger, include_rotated=False)
+    kept = [r for r in live if r.get("kind") == "rs_perf_baseline"]
+    assert len(kept) == 1
+    assert kept[0]["cells"]["xor|encode|16MiB"]["gbps"] == 3.0  # newest
+    assert perfbase.valid_baseline(kept[0])  # carried intact
+    assert perfbase.load_baseline(
+        runlog.read_records(ledger), "h1", "cpu") is not None
+
+
 def test_torn_line_is_skipped(ledger):
     runlog.record({"op": "encode", "bytes": 1}, ledger)
     with open(ledger, "a") as fp:
