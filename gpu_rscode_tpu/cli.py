@@ -151,6 +151,15 @@ Subcommands: rs update ARCHIVE --at OFF --in DELTA [--recover] [--json]
             back to the ledger; the same ranking feeds the daemon's
             GET /health, rs_durability_* gauges and the repair
             work queue; docs/HEALTH.md)
+            rs maint [--ledger PATH] [--root DIR ...] [--drain]
+            [--watch [SECS] [--count N]] [--max-jobs N] [--json]
+            (background-maintenance controller: drains the repair work
+            queue, age/update-driven scrubs and dead-heavy bucket
+            compactions as idempotent lease-claimed jobs, throttled by
+            an SLO burn-rate governor and an RS_MAINT_BYTES_PER_S token
+            bucket; default lists the pending queue, --drain runs until
+            converged, --watch loops like the daemon's resident tenant;
+            docs/MAINT.md)
             rs perf [--runlog PATH] [--captures DIR] [--record]
             [--check] [--drift-frac F] [--host H] [--backend B] [--json]
             (per-(host,backend,strategy,op,shape-bucket) throughput
@@ -651,6 +660,10 @@ def main(argv: list[str] | None = None) -> int:
         from .obs.health import main as _health_main
 
         return _health_main(argv[1:])
+    if argv and argv[0] == "maint":
+        from .maint.controller import main as _maint_main
+
+        return _maint_main(argv[1:])
     if argv and argv[0] == "perf":
         from .obs.perfbase import main as _perf_main
 

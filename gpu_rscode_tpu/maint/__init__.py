@@ -1,0 +1,24 @@
+"""``rs maint`` — the always-on background-maintenance plane.
+
+ROADMAP item 3's control loop: repair, scrub and compaction turned from
+one-shot CLI batch loops into a continuously running, throttled tenant
+(docs/MAINT.md).  The measurement half already exists — every detection
+site emits durable ``rs_damage`` events and :func:`obs.health.work_queue`
+replays them into the deterministic risk-ranked iterator — this package
+is the consumer that closes the loop:
+
+* :mod:`.controller` — the :class:`~.controller.MaintController` state
+  machine: drain the three work sources (ledger-driven repair + scrub,
+  store-stats-driven compaction) into idempotent jobs, paced by a
+  burn-rate governor polling the SLO engine (foreground tenants burning
+  error budget pause maintenance, with hysteresis) and a token bucket
+  capping device bytes per second.  Progress lives only in the ledger:
+  kill the process mid-job and the next pass converges.
+
+Import cost: stdlib only at package level; repair/scrub/compaction jobs
+import the jax stack lazily when they actually run.
+"""
+
+from __future__ import annotations
+
+__all__ = ["controller"]

@@ -58,7 +58,7 @@ SCHEMA_VERSION = 1
 # always present, whatever the environment looks like.
 SECTIONS = ("python", "jax", "native", "mesh", "env", "decoder", "update",
             "store", "strategies", "ledger", "metrics_endpoint", "serve",
-            "slo", "roofline", "health", "perf")
+            "slo", "roofline", "health", "perf", "maint")
 
 
 def _jax_section() -> dict:
@@ -438,6 +438,43 @@ def _health_section(ledger_records: list[dict]) -> dict:
     return out
 
 
+def _maint_section(ledger_records: list[dict]) -> dict:
+    """Background-maintenance facts (maint/controller.py, docs/MAINT.md):
+    is the daemon plane enabled, what would the controller work on right
+    now (repair / scrub / compaction-eligible counts from the shared
+    ledger replay), and the throttle knobs it would run under."""
+    out: dict = {"enabled": False, "tenant": None, "repairs": 0,
+                 "scrubs": 0, "claimed": 0,
+                 "knobs": {k: os.environ.get(k) for k in
+                           ("RS_MAINT", "RS_MAINT_TENANT",
+                            "RS_MAINT_BYTES_PER_S", "RS_MAINT_BURN_PAUSE",
+                            "RS_MAINT_RESUME", "RS_MAINT_LEASE_S",
+                            "RS_MAINT_INTERVAL_S")},
+                 "error": None}
+    try:
+        from ..maint import controller as _maint
+
+        out["enabled"] = _maint.enabled()
+        out["tenant"] = _maint.tenant_env()
+        if not _runlog.enabled():
+            out["error"] = "RS_RUNLOG unset (no damage ledger)"
+            return out
+        from . import health as _health
+
+        state = _health.replay(ledger_records)
+        now = time.time()
+        for item in _health.work_queue(state, now=now):
+            if item["action"] == "repair":
+                out["repairs"] += 1
+            else:
+                out["scrubs"] += 1
+            if item.get("claimed_by"):
+                out["claimed"] += 1
+    except Exception as e:
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def _perf_section(ledger_records: list[dict]) -> dict:
     """Perf-baseline facts (obs/perfbase.py, docs/OBSERVABILITY.md
     "Perf attribution & baselines"): replay the shared ledger-record
@@ -657,6 +694,7 @@ def collect(probe_endpoint: bool = True,
         "roofline": _roofline_section(ledger_records),
         "health": _health_section(ledger_records),
         "perf": _perf_section(ledger_records),
+        "maint": _maint_section(ledger_records),
     }
     warnings = []
     if not jax_info["importable"]:
@@ -733,6 +771,19 @@ def render(report: dict) -> str:
                if h["snapshot_age_s"] is not None else "")
             + (f", {h['snapshots_corrupt']} corrupt snapshot(s) skipped"
                if h["snapshots_corrupt"] else "")
+        )
+    mt = report["maint"]
+    if mt["error"]:
+        maint_line = f"[--] maint: {mt['error']}"
+    else:
+        mt_knobs = ", ".join(f"{k}={v}" for k, v in mt["knobs"].items()
+                             if v is not None) or "knobs default"
+        maint_line = (
+            f"[{'ok' if mt['enabled'] else '--'}] maint: "
+            + ("daemon tenant on" if mt["enabled"]
+               else "daemon tenant off (RS_MAINT unset)")
+            + f" — queue {mt['repairs']} repair(s), {mt['scrubs']} "
+              f"scrub(s), {mt['claimed']} claimed; {mt_knobs}"
         )
     pf = report["perf"]
     if not pf["enabled"] or pf["error"]:
@@ -870,6 +921,7 @@ def render(report: dict) -> str:
            if rl["cached"] else "not calibrated (run rs analyze)"),
         health_line,
         perf_line,
+        maint_line,
     ]
     for w in report.get("warnings", []):
         lines.append(f"  warning: {w}")

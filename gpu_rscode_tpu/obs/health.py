@@ -107,6 +107,18 @@ def at_risk_threshold() -> float:
         return 0.5
 
 
+def claim_lease_s() -> float:
+    """How long a maintenance claim on a work-queue item stays live
+    (``RS_MAINT_LEASE_S``, default 300 s).  Leases, not lock files: a
+    claimant that dies mid-job leaves only a ledger record that other
+    consumers stop honoring once it ages out — no cross-process
+    filesystem state to leak or clean up."""
+    try:
+        return float(os.environ.get("RS_MAINT_LEASE_S", 300.0))
+    except ValueError:
+        return 300.0
+
+
 # -- damage-event emission (the api.py detection sites call this) ------------
 
 
@@ -161,6 +173,57 @@ def record_damage(
         ).labels(event=str(event)).inc()
     except Exception:
         pass  # never fail the detecting operation
+
+
+def record_claim(archive: str, owner: str, *,
+                 lease_s: float | None = None,
+                 ledger_path: str | None = None) -> None:
+    """Append a ``claim`` event: ``owner`` is about to work on
+    ``archive``, and other :func:`work_queue` consumers should skip it
+    until the lease expires or a completing ``repair``/``scan`` event
+    clears it.  Rides the damage ledger (``kind=rs_damage``), so older
+    readers skip it via the unknown-event branch.  Never raises."""
+    try:
+        if ledger_path is None and not _runlog.enabled():
+            return
+        _runlog.record({
+            "kind": DAMAGE_KIND,
+            "cls": "damage",
+            "event": "claim",
+            "archive": os.path.abspath(archive),
+            "owner": str(owner),
+            "lease_s": float(lease_s if lease_s is not None
+                             else claim_lease_s()),
+        }, ledger_path)
+        _metrics.counter(
+            "rs_durability_damage_events_total",
+            "damage-plane events appended to the run ledger",
+        ).labels(event="claim").inc()
+    except Exception:
+        pass  # claiming is advisory; never fail the maintenance job
+
+
+def record_release(archive: str, owner: str, *,
+                   ledger_path: str | None = None) -> None:
+    """Append a ``release`` event: ``owner`` gives up its claim without
+    completing the job (e.g. backing off a repeatedly failing archive).
+    Only the claim holder's release clears the claim.  Never raises."""
+    try:
+        if ledger_path is None and not _runlog.enabled():
+            return
+        _runlog.record({
+            "kind": DAMAGE_KIND,
+            "cls": "damage",
+            "event": "release",
+            "archive": os.path.abspath(archive),
+            "owner": str(owner),
+        }, ledger_path)
+        _metrics.counter(
+            "rs_durability_damage_events_total",
+            "damage-plane events appended to the run ledger",
+        ).labels(event="release").inc()
+    except Exception:
+        pass
 
 
 # -- per-archive state machine (docs/HEALTH.md) ------------------------------
@@ -272,6 +335,10 @@ def _apply_event(state: dict, rec: dict) -> None:
         a["scrub_generation"] = a["generation"]
         if a["chunks"]:
             a["last_damage_ts"] = ts
+        # A full scan is a completed maintenance pass: whoever held the
+        # claim is done with it (ledger-driven convergence — no separate
+        # release write on the happy path).
+        a.pop("claim", None)
     elif event == "syndrome":
         located = rec.get("chunks") or []
         for idx in located:
@@ -298,8 +365,29 @@ def _apply_event(state: dict, rec: dict) -> None:
                 continue
         a["repairs"] += 1
         a["last_repair_ts"] = ts
+        a.pop("claim", None)  # job completion clears the claim
     elif event == "repair_failed":
+        # Deliberately does NOT clear the claim: lease expiry paces
+        # retries of an archive that keeps failing to repair.
         a["repair_failures"] += 1
+    elif event == "claim":
+        # The claim key exists ONLY while a claim is live — never in
+        # _new_archive() — so canonical() stays byte-identical for
+        # claim-free fleets (the chaos digests' replay witness).
+        try:
+            lease = float(rec.get("lease_s"))
+        except (TypeError, ValueError):
+            lease = claim_lease_s()
+        a["claim"] = {
+            "owner": str(rec.get("owner") or "?"),
+            "ts": ts,
+            "lease_s": lease,
+        }
+    elif event == "release":
+        claim = a.get("claim")
+        if isinstance(claim, dict) and \
+                claim.get("owner") == str(rec.get("owner") or "?"):
+            a.pop("claim", None)
     elif event == "update":
         gen = rec.get("generation")
         if isinstance(gen, int) and not isinstance(gen, bool):
@@ -478,6 +566,23 @@ def _rank_key(row: dict):
     return (-row["risk"], -row["lost"], row["margin"], row["archive"])
 
 
+def live_claim(a: dict, now: float | None = None) -> str | None:
+    """The owner of a still-live claim on this archive, or None once the
+    lease has expired (or no claim was ever recorded)."""
+    claim = a.get("claim")
+    if not isinstance(claim, dict):
+        return None
+    now = time.time() if now is None else float(now)
+    try:
+        ts = float(claim.get("ts") or 0.0)
+        lease = float(claim.get("lease_s") or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if now >= ts + lease:
+        return None  # lease expired: the claimant is presumed dead
+    return claim.get("owner")
+
+
 def work_queue(state: dict, now: float | None = None) -> list[dict]:
     """The risk-ordered maintenance queue — the iterator ROADMAP item
     3's repair scheduler consumes.
@@ -485,7 +590,11 @@ def work_queue(state: dict, now: float | None = None) -> list[dict]:
     An archive enters the queue when it needs REPAIR (damaged chunks
     outstanding) or a SCRUB (never scanned, generation moved past the
     last verified scan, or the scan aged past the staleness horizon).
-    Ordering is the same deterministic rank as the fleet table.
+    ``reason`` says why (``damage``/``update``/``never_scanned``/
+    ``stale``); ``claimed_by`` carries the live lease holder (or None)
+    so a one-shot ``rs maint --drain`` and a live daemon sharing a root
+    never double-repair the same archive.  Ordering is the same
+    deterministic rank as the fleet table.
     """
     now = time.time() if now is None else float(now)
     tau = scrub_max_age_s()
@@ -493,23 +602,24 @@ def work_queue(state: dict, now: float | None = None) -> list[dict]:
     for archive, a in state["archives"].items():
         row = risk(a, now=now)
         last = a.get("last_scrub_ts")
-        needs_scrub = (
-            last is None
-            or a.get("scrub_generation") != a.get("generation")
-            or (tau > 0 and now - last >= tau)
-        )
         if row["lost"] > 0:
-            action = "repair"
-        elif needs_scrub:
-            action = "scrub"
+            action, reason = "repair", "damage"
+        elif last is None:
+            action, reason = "scrub", "never_scanned"
+        elif a.get("scrub_generation") != a.get("generation"):
+            action, reason = "scrub", "update"
+        elif tau > 0 and now - last >= tau:
+            action, reason = "scrub", "stale"
         else:
             continue
         items.append({
             "archive": archive,
             "action": action,
+            "reason": reason,
             "risk": row["risk"],
             "margin": row["margin"],
             "lost": row["lost"],
+            "claimed_by": live_claim(a, now),
         })
     items.sort(key=_rank_key)
     return items

@@ -109,6 +109,18 @@ _PINNED_ENV = {
     "RS_STORE_SNAPSHOT_RECORDS": "32",
     "RS_STORE_SNAPSHOT_KEEP": None,
     "RS_STORE_SNAPSHOT_DISABLE": None,
+    # The maint class drives the controller directly (and the crash
+    # knob per schedule); ambient maint knobs would change job pacing,
+    # lease lifetimes or inject crashes into every other class's
+    # repairs — verdict drift.
+    "RS_MAINT": None,
+    "RS_MAINT_TENANT": None,
+    "RS_MAINT_BYTES_PER_S": None,
+    "RS_MAINT_BURN_PAUSE": None,
+    "RS_MAINT_RESUME": None,
+    "RS_MAINT_LEASE_S": None,
+    "RS_MAINT_INTERVAL_S": None,
+    "RS_MAINT_CRASH": None,
 }
 
 
@@ -465,6 +477,77 @@ def plan_health_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
     }
 
 
+def plan_maint_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
+    """The MAINT convergence class (``rs chaos --maint``): prove the
+    background-maintenance plane (maint/controller.py, docs/MAINT.md)
+    converges through crashes.  Each iteration builds a small fleet
+    with one damaged victim plus a store bucket driven dead-heavy by a
+    seeded put/delete schedule, then drains a :class:`MaintController`
+    that may be killed (``RS_MAINT_CRASH``) at a random job stage —
+    after claiming a repair, mid-repair before the clean rescan, after
+    claiming a scrub, or before/after a compaction.  A second
+    controller with the SAME owner (the restarted daemon) must then
+    converge: empty work queue, zero pending compactions, the victim's
+    chunks byte-identical to their pre-damage snapshot, the bucket's
+    live objects byte-identical to a sequential mirror, and
+    snapshot+delta ledger replay equal to pure-delta replay even with a
+    live claim checkpointed mid-history.
+
+    Deterministic from ``(seed, i)`` on its own derived stream
+    (``rs-chaos-maint:*`` — the classic/silent/update/object/health
+    schedules and digests are untouched by this class existing).
+    """
+    rng = random.Random(f"rs-chaos-maint:{seed}:{i}")
+    k = rng.randint(2, 4)
+    p = rng.randint(1, 2)
+    w = 8
+    n_archives = rng.randint(2, 3)
+    sizes = [rng.randint(256, max_bytes) for _ in range(n_archives)]
+    victim = rng.randrange(n_archives)
+    n_damage = rng.randint(1, p)
+    targets = sorted(rng.sample(range(k + p), n_damage))
+    events = []
+    for c in targets:
+        kind = rng.choice(("bitrot", "torn", "unlink"))
+        if kind == "bitrot":
+            events.append({"kind": "bitrot", "chunk": c,
+                           "count": rng.randint(1, 64)})
+        elif kind == "torn":
+            events.append({"kind": "torn", "chunk": c,
+                           "keep_frac": rng.random() * 0.9})
+        else:
+            events.append({"kind": "unlink", "chunk": c})
+    # Bucket schedule: small stripes + deleting most objects drives
+    # sealed archives past RS_STORE_COMPACT_DEAD_FRAC deterministically.
+    stripe_bytes = rng.choice([4096, 8192])
+    n_objects = rng.randint(4, 7)
+    puts = [{"key": f"o{j}", "len": rng.randint(64, 2048)}
+            for j in range(n_objects)]
+    keep = rng.randint(1, 2)
+    kept = set(rng.sample([pt["key"] for pt in puts], keep))
+    deletes = [pt["key"] for pt in puts if pt["key"] not in kept]
+    crash = rng.choice([None, "repair:claimed", "repair:mid",
+                        "scrub:claimed", "compact:claimed",
+                        "compact:done"])
+    return {
+        "seed": seed,
+        "iter": i,
+        "mode": "maint",
+        "k": k,
+        "p": p,
+        "w": w,
+        "archives": n_archives,
+        "sizes": sizes,
+        "victim": victim,
+        "events": events,
+        "stripe_bytes": stripe_bytes,
+        "puts": puts,
+        "deletes": deletes,
+        "crash": crash,
+        "faults": "",
+    }
+
+
 def plan_iteration(seed: int, i: int, max_bytes: int = 49152) -> dict:
     """The deterministic schedule for iteration ``i`` of master ``seed``."""
     rng = _iter_rng(seed, i)
@@ -690,6 +773,8 @@ def run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
             return _run_object_iteration(cfg, workdir, keep=keep)
         if cfg.get("mode") == "health":
             return _run_health_iteration(cfg, workdir, keep=keep)
+        if cfg.get("mode") == "maint":
+            return _run_maint_iteration(cfg, workdir, keep=keep)
         return _run_iteration(cfg, workdir, keep=keep)
 
 
@@ -1454,6 +1539,182 @@ def _run_health_iteration(cfg: dict, workdir: str, *,
     }
 
 
+def _run_maint_iteration(cfg: dict, workdir: str, *,
+                         keep: bool = False) -> dict:
+    """One ``maint``-class iteration: build the damaged fleet + the
+    dead-heavy bucket, drain a controller that crashes at the scheduled
+    job stage, then prove a same-owner restart converges
+    (:func:`plan_maint_iteration` doc)."""
+    from .. import api, store
+    from ..maint import controller as _maint
+    from ..obs import health as _health
+    from ..utils.fileformat import chunk_size_for
+
+    seed, i = cfg["seed"], cfg["iter"]
+    k, p, w = cfg["k"], cfg["p"], cfg["w"]
+    rng = random.Random(f"rs-chaos-maint-run:{seed}:{i}")
+    base = os.path.join(workdir, f"iter{i}")
+    root = os.path.join(base, "store")
+    os.makedirs(root, exist_ok=True)
+    ledger = os.path.join(base, "maint_ledger.jsonl")
+    damaged = sorted({ev["chunk"] for ev in cfg["events"]})
+    # Private ledger + pinned knobs (the health-class discipline):
+    # verdicts must be a function of the seed alone, and the ambient
+    # ledger must not absorb this fleet's damage or claim events.
+    saved_env = {
+        kk: os.environ.get(kk)
+        for kk in ("RS_RUNLOG", "RS_RUNLOG_MAX_BYTES",
+                   "RS_HEALTH_SCRUB_MAX_AGE_S", "RS_HEALTH_AT_RISK",
+                   "RS_SCHEDULE_STORE", "RS_MAINT_CRASH")
+    }
+    ok = False
+    crashed = False
+    try:
+        os.environ["RS_RUNLOG"] = ledger
+        os.environ.pop("RS_RUNLOG_MAX_BYTES", None)
+        os.environ.pop("RS_HEALTH_SCRUB_MAX_AGE_S", None)
+        os.environ.pop("RS_HEALTH_AT_RISK", None)
+        os.environ["RS_SCHEDULE_STORE"] = "off"
+        os.environ.pop("RS_MAINT_CRASH", None)
+
+        fnames = []
+        for a, size in enumerate(cfg["sizes"]):
+            fname = os.path.join(base, f"chaos_maint_{i}_{a}.bin")
+            data = random.Random(
+                f"rs-chaos-data:{seed}:{i}:{a}").randbytes(size)
+            with open(fname, "wb") as fp:
+                fp.write(data)
+            api.encode_file(fname, k, p, checksums=True, w=w)
+            api.scan_file(fname)
+            fnames.append(fname)
+        victim = os.path.abspath(fnames[cfg["victim"]])
+        # Chunk bytes BEFORE damage — repair must restore them exactly
+        # (snapshot drops the trailing .METADATA entry: repair rewrites
+        # identical chunk bytes, metadata line order is its own).
+        pre_chunks = _archive_snapshot(victim, k + p)[:-1]
+        chunk = chunk_size_for(cfg["sizes"][cfg["victim"]], k, w // 8)
+        _apply_events(victim, cfg["events"], chunk, rng)
+        api.scan_file(victim)
+
+        store.drop_cached()
+        bucket = store.open_bucket(
+            root, "bkt", create=True, k=k, p=p, w=w,
+            stripe_bytes=cfg["stripe_bytes"],
+        )
+        mirror: dict[str, bytes] = {}
+        for j, pt in enumerate(cfg["puts"]):
+            data = random.Random(
+                f"rs-chaos-maint-obj:{seed}:{i}:{j}").randbytes(pt["len"])
+            bucket.put(pt["key"], data)
+            mirror[pt["key"]] = data
+        for key in cfg["deletes"]:
+            bucket.delete(key)
+            mirror.pop(key, None)
+
+        # Drain #1: the controller that may die mid-job.  Same-owner
+        # restart is the daemon contract (docs/MAINT.md) — a restarted
+        # process reclaims its own leases immediately.
+        if cfg["crash"]:
+            os.environ["RS_MAINT_CRASH"] = cfg["crash"]
+        ctl = _maint.MaintController(
+            ledger_path=ledger, store_roots=[root],
+            owner="chaos:maint", bytes_per_s=float(1 << 30),
+            interval_s=0.01)
+        try:
+            ctl.drain()
+        except _maint.MaintCrash:
+            crashed = True
+        _check(bool(cfg["crash"]) or not crashed, cfg,
+               "controller crashed with no crash scheduled")
+        os.environ.pop("RS_MAINT_CRASH", None)
+
+        # Checkpoint mid-history — a live claim (post-crash) must ride
+        # the snapshot byte-exactly (the restart-stability check below
+        # replays both ways).
+        _health.write_snapshot(_health.load(ledger), ledger)
+
+        # Drain #2: the "restarted" process — fresh store view, same
+        # owner — must converge with nothing left actionable.
+        store.drop_cached()
+        ctl2 = _maint.MaintController(
+            ledger_path=ledger, store_roots=[root],
+            owner="chaos:maint", bytes_per_s=float(1 << 30),
+            interval_s=0.01)
+        out = ctl2.drain()
+        _check(out["remaining"] == 0, cfg,
+               f"restart drain left {out['remaining']} job(s) queued")
+        _check(out["skipped_claimed"] == 0, cfg,
+               "restart drain blocked on its own leases")
+
+        state = _health.load(ledger)
+        wq = _health.work_queue(state)
+        _check(not wq, cfg,
+               f"work queue not empty after convergence: {wq[:2]}")
+        post_chunks = _archive_snapshot(victim, k + p)[:-1]
+        _check(post_chunks == pre_chunks, cfg,
+               "repair did not restore the victim's chunk bytes")
+
+        bucket = store.open_bucket(root, "bkt")
+        stats = bucket.stats()
+        _check(stats["pending_compactions"] == 0, cfg,
+               f"{stats['pending_compactions']} dead-heavy archive(s) "
+               "still pending compaction")
+        listed = {o["key"] for o in bucket.list_objects()}
+        _check(listed == set(mirror), cfg,
+               f"live keys {sorted(listed)} != mirror {sorted(mirror)}")
+        for key, want in mirror.items():
+            _check(bucket.get(key) == want, cfg,
+                   f"GET {key!r} != mirror after maintenance")
+
+        # Restart stability with claims in history: snapshot+delta
+        # replay must equal pure-delta replay from genesis.
+        c_a = _health.canonical(_health.load(ledger))
+        c_pure = _health.canonical(
+            _health.load(ledger, use_snapshots=False))
+        _check(c_a == c_pure, cfg,
+               "snapshot+delta replay != pure-delta replay")
+        ok = True
+    except ChaosFailure:
+        raise
+    except Exception as e:
+        raise ChaosFailure(
+            cfg, f"unexpected {type(e).__name__}: {e}"
+        ) from e
+    finally:
+        for kk, vv in saved_env.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+        verdict = "pass" if ok else "fail"
+        _metrics.counter(
+            "rs_chaos_iterations_total", "chaos-harness iteration verdicts"
+        ).labels(verdict=verdict).inc()
+        if _runlog.enabled():
+            _runlog.record({
+                "op": "chaos_iter",
+                "config": {"k": k, "n": k + p, "w": w},
+                "bytes": sum(cfg["sizes"]),
+                "chaos": {
+                    "seed": seed, "iter": i, "mode": "maint",
+                    "events": cfg["events"], "crash": cfg["crash"],
+                    "faults": cfg["faults"],
+                },
+                "outcome": "ok" if ok else "error",
+            })
+        if ok and not keep:
+            shutil.rmtree(base, ignore_errors=True)
+    return {
+        "iter": i, "mode": "maint", "k": k, "p": p, "w": w,
+        "archives": len(cfg["sizes"]), "damaged": damaged,
+        "objects": len(cfg["puts"]), "deleted": len(cfg["deletes"]),
+        "crash": cfg["crash"] or "none", "crashed": crashed,
+        "repaired": True, "pending_cleared": True,
+        "mirror_match": True, "replay_identical": True,
+        "verdict": "pass",
+    }
+
+
 def _run_iteration(cfg: dict, workdir: str, *, keep: bool = False) -> dict:
     from .. import api
     from ..utils.fileformat import (
@@ -1690,6 +1951,14 @@ def main(argv: list[str] | None = None) -> int:
                     "map, clear it after repair, and replay snapshot+"
                     "delta byte-identically — own seed stream "
                     "(docs/HEALTH.md)")
+    ap.add_argument("--maint", action="store_true",
+                    help="run the MAINT convergence class: a damaged "
+                    "fleet plus a dead-heavy bucket drained by the "
+                    "maintenance controller, killed (RS_MAINT_CRASH) at "
+                    "a scheduled job stage — a same-owner restart must "
+                    "converge to an empty work queue, zero pending "
+                    "compactions and byte-identical archive/object "
+                    "state — own seed stream (docs/MAINT.md)")
     ap.add_argument("--json", action="store_true",
                     help="emit one JSON line per iteration")
     ap.add_argument("--keep", action="store_true",
@@ -1712,9 +1981,11 @@ def main(argv: list[str] | None = None) -> int:
             print(f"rs chaos: bad --repro JSON: {e}", file=sys.stderr)
             return 2
     else:
-        if sum((args.silent, args.update, args.object, args.health)) > 1:
+        if sum((args.silent, args.update, args.object, args.health,
+                args.maint)) > 1:
             print("rs chaos: --silent / --update / --object / --health "
-                  "conflict; pick one workload class", file=sys.stderr)
+                  "/ --maint conflict; pick one workload class",
+                  file=sys.stderr)
             return 2
         if args.group and not args.update:
             print("rs chaos: --group modifies --update (the grouped "
@@ -1727,6 +1998,7 @@ def main(argv: list[str] | None = None) -> int:
             else plan_silent_iteration if args.silent
             else plan_object_iteration if args.object
             else plan_health_iteration if args.health
+            else plan_maint_iteration if args.maint
             else plan_iteration
         )
         cfgs = [plan(args.seed, i, args.max_bytes) for i in indices]
@@ -1746,6 +2018,7 @@ def main(argv: list[str] | None = None) -> int:
                 "silent": "--silent ", "update": "--update ",
                 "update_group": "--update --group ",
                 "object": "--object ", "health": "--health ",
+                "maint": "--maint ",
             }.get(cfg.get("mode"), "")
             print(
                 f"rs chaos: replay the original with: rs chaos "

@@ -79,6 +79,7 @@ from ..obs import (
     slo as _slo,
     tracing as _tracing,
 )
+from ..maint import controller as _maint
 from ..utils.timing import PhaseTimer
 from . import objcache as _objcache
 from .batcher import Batcher
@@ -184,6 +185,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # /healthz — that answers "is the daemon up", this
                 # answers "which archives are closest to data loss".
                 self._send_json(200, self.daemon.fleet_health())
+            elif url.path == "/maint":
+                # Maintenance-plane state (docs/MAINT.md): governor
+                # pause/resume, job tallies, and a fresh work-queue
+                # snapshot replayed from the damage ledger.
+                self._send_json(200, self.daemon.maint_report())
             elif url.path == "/perf":
                 # Perf-baseline drift report (obs/perfbase.py): the
                 # same per-cell table `rs perf` renders, replayed from
@@ -708,7 +714,8 @@ class ServeDaemon:
                  request_timeout_s: float | None = None,
                  max_body: int | None = None,
                  slo_spec: str | None = None,
-                 obj_cache_bytes: int | None = None):
+                 obj_cache_bytes: int | None = None,
+                 maint: bool | None = None):
         self.root = os.path.abspath(root)
         os.makedirs(self.root, exist_ok=True)
         self.addr = addr if addr is not None else os.environ.get(
@@ -753,6 +760,24 @@ class ServeDaemon:
         # the windowed read lane on GET /o/; RS_OBJ_CACHE_BYTES caps it
         # (0 disables — every GET reports cache=bypass).
         self.objcache = _objcache.ObjectCache(obj_cache_bytes)
+        # Background-maintenance plane (docs/MAINT.md): repair/scrub/
+        # compaction jobs admitted through THIS queue as the maint
+        # tenant, paced by the SLO burn-rate governor.  Off unless
+        # RS_MAINT is set or the caller passes maint=True (`rs serve
+        # --maint`); disabled means no controller object, zero threads.
+        self.maint = None
+        maint_on = _maint.enabled() if maint is None else bool(maint)
+        if maint_on:
+            self.maint = _maint.MaintController(
+                store_roots=self._maint_store_roots,
+                # Restart-stable owner: a daemon that died mid-job and
+                # came back on the same root reclaims its own leases
+                # immediately instead of waiting them out.
+                owner=f"{os.uname().nodename}:serve:{self.root}",
+                slo_report=self.slo.export_gauges
+                if self.slo.objectives else None,
+                submit=self._submit_maint_job,
+            )
         self._trace_cm = None  # daemon-lifetime RS_TRACE session
         self._started = time.time()
         self._closed = False
@@ -785,6 +810,64 @@ class ServeDaemon:
             for key in keys:
                 stack.enter_context(self._name_lock(key))
             yield
+
+    # -- maintenance plane (docs/MAINT.md) -----------------------------------
+
+    def _maint_store_roots(self) -> list[str]:
+        """Per-tenant dirs under the data root — where object-store
+        buckets live (`/o/` routes open buckets at root/tenant/name)."""
+        try:
+            return [os.path.join(self.root, t)
+                    for t in sorted(os.listdir(self.root))
+                    if os.path.isdir(os.path.join(self.root, t))]
+        except OSError:
+            return []
+
+    def _maint_lock_key(self, target: str) -> tuple:
+        """The FOREGROUND (tenant, name) lock a maintenance job must
+        hold: a repair of tenant alpha's archive excludes alpha's own
+        writes to it, not just other maint jobs.  Targets outside the
+        data root key on their absolute path."""
+        rel = os.path.relpath(os.path.abspath(target), self.root)
+        parts = rel.split(os.sep)
+        if not rel.startswith("..") and len(parts) >= 2:
+            return (parts[0], parts[1])
+        return ("rs-maint", os.path.abspath(target))
+
+    def _submit_maint_job(self, job, *, name: str, cost: int):
+        """The controller's dispatch hook: wrap the job closure as an
+        op="maint" request, admit it through the DRR queue (tenant =
+        the maint tenant, cost pre-inflated by the controller), block
+        until the executor ran it.  QueueFull/Draining surface as
+        backpressure — the controller's pass stops and retries next
+        interval instead of overwhelming a loaded daemon."""
+        req = Request("maint", self.maint.tenant, name, "", cost=cost)
+        req.job = job
+        req.lock_key = self._maint_lock_key(name)
+        try:
+            self.queue.submit(req)
+        except (QueueFull, Draining) as e:
+            raise _maint.MaintBackpressure(str(e)) from e
+        if not req.done.wait(timeout=600.0):
+            raise TimeoutError(f"maint job on {name!r} did not finish")
+        if req.outcome == "ok":
+            return req.result
+        if isinstance(req.error, BaseException):
+            raise req.error
+        raise RuntimeError(f"maint job outcome {req.outcome!r}")
+
+    def maint_report(self) -> dict:
+        """``GET /maint``: controller state + a fresh work-queue
+        snapshot (the queue block replays the damage ledger per call —
+        the same freshness contract as ``GET /health``)."""
+        if self.maint is None:
+            return {
+                "kind": "rs_maint", "enabled": False,
+                "error": "maintenance plane off (start with --maint or "
+                "RS_MAINT=1)",
+            }
+        return {"kind": "rs_maint", "enabled": True,
+                **self.maint.stats(include_queue=True)}
 
     @staticmethod
     def _promote_upload(req: Request) -> None:
@@ -838,6 +921,8 @@ class ServeDaemon:
             target=self._server.serve_forever, name="rs-serve-http",
             daemon=True)
         self._serve_thread.start()
+        if self.maint is not None:
+            self.maint.start()
         return self
 
     def warm(self, k: int, p: int, *, w: int = 8, strategy: str = "auto",
@@ -882,6 +967,10 @@ class ServeDaemon:
         if self._closed:
             return
         self._closed = True
+        if self.maint is not None:
+            # Stop sourcing new maintenance jobs BEFORE the drain; an
+            # in-flight job finishes inside it like any other request.
+            self.maint.stop(wait=False)
         if drain:
             self.drain(timeout)
         else:
@@ -1030,6 +1119,12 @@ class ServeDaemon:
                 "enabled": _reqtrace.enabled(),
                 "ring": _reqtrace.ring_capacity(),
             },
+            # Background-maintenance plane (docs/MAINT.md): controller
+            # counters only — the ledger-replaying queue snapshot lives
+            # on GET /maint, not in every /stats scrape.
+            "maint": ({"enabled": True, **self.maint.stats()}
+                      if self.maint is not None
+                      else {"enabled": False}),
         }
 
     def _store_block(self) -> dict:
@@ -1455,6 +1550,16 @@ class ServeDaemon:
         # hot path pays nothing extra when telemetry is off.
         timer = PhaseTimer(enabled=req.stages is not None)
         try:
+            if req.op == "maint":
+                # Maintenance job closure (docs/MAINT.md): runs under
+                # the FOREGROUND (tenant, name) lock of its target so a
+                # repair excludes that archive's own writes; errors land
+                # in the generic handler like any other op (no-wedge).
+                with self._name_lock(req.lock_key
+                                     or (req.tenant, req.name)):
+                    result = req.job()
+                self._finish(req, "ok", result=result)
+                return
             with self._name_lock((req.tenant, req.name)):
                 if req.op == "encode":
                     self._promote_upload(req)
@@ -1563,6 +1668,10 @@ def main(argv=None) -> int:
                     help="per-tenant SLO objectives (same grammar as "
                     "RS_SLO, e.g. 'default:encode:p99=250ms,avail=99.9'; "
                     "GET /slo reports attainment + burn rates)")
+    ap.add_argument("--maint", action="store_true",
+                    help="run the background-maintenance plane (repair/"
+                    "scrub/compaction as a throttled tenant; also "
+                    "RS_MAINT=1 — docs/MAINT.md)")
     try:
         args = ap.parse_args(argv)
     except SystemExit as e:
@@ -1595,6 +1704,7 @@ def main(argv=None) -> int:
             root, port=args.port, addr=args.addr, depth=args.depth,
             batch_ms=args.batch_ms, max_batch=args.max_batch,
             workers=args.workers, slo_spec=args.slo,
+            maint=True if args.maint else None,
         )
     except _slo.SLOSpecError as e:
         print(f"rs serve: bad --slo/RS_SLO spec: {e}", file=sys.stderr)
@@ -1636,7 +1746,9 @@ def main(argv=None) -> int:
     print(f"rs serve: listening on http://{daemon.addr}:{daemon.port} "
           f"(root {daemon.root}, depth {daemon.queue.max_depth}, "
           f"batch {daemon.batcher.batch_ms}ms x{daemon.batcher.max_batch}, "
-          f"{daemon.workers} workers) — SIGTERM drains", file=sys.stderr)
+          f"{daemon.workers} workers"
+          f"{', maint on' if daemon.maint is not None else ''}) "
+          f"— SIGTERM drains", file=sys.stderr)
     try:
         stop.wait()
     finally:

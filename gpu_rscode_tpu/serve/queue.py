@@ -58,7 +58,7 @@ class Request:
         "deadline", "batch_size",
         "queue_wait_s", "service_s", "outcome", "result", "error", "done",
         "req_id", "batch_id", "group_id", "t_dispatch", "stages",
-        "cache", "path",
+        "cache", "path", "job", "lock_key",
     )
 
     def __init__(self, op: str, tenant: str, name: str, spool: str, *,
@@ -113,6 +113,13 @@ class Request:
         # fields, None for every other op.
         self.cache: str | None = None
         self.path: str | None = None
+        # Maintenance-plane requests (op="maint", docs/MAINT.md): the
+        # zero-arg job closure the executor runs, and the foreground
+        # (tenant, name) lock the job must serialize against (a repair
+        # of tenant alpha's archive must exclude alpha's own writes to
+        # it, not just other maint jobs).
+        self.job = None
+        self.lock_key: tuple | None = None
 
     def shape_key(self) -> tuple:
         """The plan-cache shape bucket this request dispatches under —
@@ -135,6 +142,9 @@ class Request:
             # Reads/deletes serialize under the bucket lock anyway;
             # grouping buys nothing — keep them solo batches.
             return (self.op, self.tenant, self.name, self.seq)
+        if self.op == "maint":
+            # Maintenance jobs are opaque closures — nothing to coalesce.
+            return (self.op, self.tenant, self.seq)
         return (self.op, self.k, self.p, self.w, self.strategy,
                 self.generator, self.layout)
 

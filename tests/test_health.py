@@ -297,8 +297,8 @@ def test_scan_corrupt_repair_lifecycle(tmp_path, ledger):
     assert top["lost"] == 2 and top["margin"] == 0
     assert top["bucket"] == "critical"
     assert rep["work_queue"][0] == {
-        "archive": key, "action": "repair", "risk": top["risk"],
-        "margin": 0, "lost": 2}
+        "archive": key, "action": "repair", "reason": "damage",
+        "risk": top["risk"], "margin": 0, "lost": 2, "claimed_by": None}
 
     rebuilt = api.repair_file(path)
     assert sorted(rebuilt) == [1, 4]

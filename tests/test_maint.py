@@ -1,0 +1,595 @@
+"""Background-maintenance plane (gpu_rscode_tpu/maint/, docs/MAINT.md):
+the token-bucket byte throttle, the burn-rate governor's pause/resume
+hysteresis, claim-lease semantics on the damage ledger, discovery
+ordering and skip accounting, end-to-end drain convergence for repair /
+scrub / compaction, idempotent re-execution after an injected
+mid-repair crash, double-repair prevention across owners, the `rs
+maint` CLI, the daemon's GET /maint, the disabled-path guard, and the
+doctor section.
+"""
+
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from gpu_rscode_tpu import api, cli, store
+from gpu_rscode_tpu.maint import controller as maint
+from gpu_rscode_tpu.obs import doctor, health, metrics, runlog
+from gpu_rscode_tpu.serve.daemon import ServeDaemon
+from gpu_rscode_tpu.utils.fileformat import chunk_file_name
+
+
+@pytest.fixture
+def ledger(tmp_path, monkeypatch):
+    p = str(tmp_path / "runlog.jsonl")
+    monkeypatch.setenv("RS_RUNLOG", p)
+    for var in ("RS_RUNLOG_MAX_BYTES", "RS_HEALTH_SCRUB_MAX_AGE_S",
+                "RS_HEALTH_AT_RISK", "RS_MAINT", "RS_MAINT_TENANT",
+                "RS_MAINT_BYTES_PER_S", "RS_MAINT_BURN_PAUSE",
+                "RS_MAINT_RESUME", "RS_MAINT_LEASE_S",
+                "RS_MAINT_INTERVAL_S", "RS_MAINT_CRASH"):
+        monkeypatch.delenv(var, raising=False)
+    store.drop_cached()
+    yield p
+    metrics.force_enable(False)
+    metrics.REGISTRY.reset()
+    store.drop_cached()
+
+
+def _mkfile(tmp_path, size, name="f.bin", seed=0):
+    path = str(tmp_path / name)
+    rng = np.random.default_rng(seed)
+    open(path, "wb").write(
+        rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+    )
+    return path
+
+
+def _corrupt(path, idx, offset=10):
+    cf = chunk_file_name(path, idx)
+    with open(cf, "r+b") as fp:
+        fp.seek(offset)
+        b = fp.read(1)
+        fp.seek(offset)
+        fp.write(bytes([b[0] ^ 0xFF]))
+
+
+def _chunks(path, n):
+    return [open(chunk_file_name(path, i), "rb").read() for i in range(n)]
+
+
+def _ctl(ledger, **kw):
+    kw.setdefault("store_roots", [])
+    kw.setdefault("owner", "test:maint")
+    kw.setdefault("bytes_per_s", float(1 << 30))
+    kw.setdefault("interval_s", 0.01)
+    return maint.MaintController(ledger_path=ledger, **kw)
+
+
+def _report(burn, tenant="alpha", op="decode"):
+    """A minimal SLO-report shape the governor folds."""
+    return {"cells": [{
+        "tenant": tenant, "op": op,
+        "windows": {"60": {"objectives": {"avail": {"burn_rate": burn}}}},
+    }]}
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as resp:
+        return resp.status, json.loads(resp.read())
+
+
+# ----- token bucket ----------------------------------------------------------
+
+
+def test_token_bucket_debt_model():
+    clock = [0.0]
+    tb = maint.TokenBucket(100.0, clock=lambda: clock[0])
+    # Burst capacity = 2 s of rate: small takes inside it are free.
+    assert tb.capacity == 200.0
+    assert tb.take(150.0) == 0.0
+    # Oversized take always succeeds and returns the debt in seconds.
+    wait = tb.take(250.0)
+    assert wait == pytest.approx(2.0)  # (150+250-200)/100
+    # Refill pays the debt down over time, clamped at capacity.
+    clock[0] = 10.0
+    assert tb.take(200.0) == 0.0
+    assert tb.taken == 600
+
+
+def test_token_bucket_floors_rate():
+    tb = maint.TokenBucket(0.0)
+    assert tb.rate == 1.0
+    assert tb.take(-5.0) == 0.0  # negative consumption is a no-op
+
+
+# ----- burn governor ---------------------------------------------------------
+
+
+def test_burn_governor_hysteresis():
+    g = maint.BurnGovernor(pause_at=1.0, resume_at=0.5)
+    assert g.observe(_report(0.4)) is False
+    assert g.observe(_report(1.0)) is True  # at the threshold pauses
+    assert g.pause_events == 1
+    # Between resume_at and pause_at: stays paused (no flapping).
+    assert g.observe(_report(0.7)) is True
+    assert g.pause_events == 1 and g.resume_events == 0
+    assert g.observe(_report(0.4)) is False
+    assert g.resume_events == 1
+    assert g.worst_cell == ("alpha", "decode", "60", "avail")
+    assert [e["action"] for e in g.events] == ["pause", "resume"]
+
+
+def test_burn_governor_ignores_maint_tenant_and_empty_reports():
+    g = maint.BurnGovernor(pause_at=1.0, resume_at=0.5,
+                           maint_tenant="maint")
+    assert g.observe(_report(9.0, tenant="maint")) is False
+    assert g.observe(None) is False
+    assert g.observe({"cells": []}) is False
+    assert g.pause_events == 0 and g.last_burn == 0.0
+
+
+def test_burn_governor_clamps_resume_to_pause():
+    g = maint.BurnGovernor(pause_at=1.0, resume_at=3.0)
+    assert g.resume_at == 1.0
+
+
+# ----- env knobs / crash points ----------------------------------------------
+
+
+def test_env_knob_defaults_and_overrides(monkeypatch):
+    for var in ("RS_MAINT", "RS_MAINT_TENANT", "RS_MAINT_BURN_PAUSE",
+                "RS_MAINT_RESUME", "RS_MAINT_BYTES_PER_S",
+                "RS_MAINT_INTERVAL_S"):
+        monkeypatch.delenv(var, raising=False)
+    assert maint.enabled() is False
+    assert maint.tenant_env() == "maint"
+    assert maint.burn_pause_env() == 1.0
+    assert maint.burn_resume_env() == 0.5
+    assert maint.bytes_per_s_env() == float(64 * 2**20)
+    assert maint.interval_env() == 5.0
+    monkeypatch.setenv("RS_MAINT", "1")
+    assert maint.enabled() is True
+    for off in ("0", "false", "off", "no", ""):
+        monkeypatch.setenv("RS_MAINT", off)
+        assert maint.enabled() is False
+    monkeypatch.setenv("RS_MAINT_TENANT", "janitor")
+    assert maint.tenant_env() == "janitor"
+    monkeypatch.setenv("RS_MAINT_BURN_PAUSE", "bogus")
+    assert maint.burn_pause_env() == 1.0  # bad value -> default
+
+
+def test_crash_point_spec(monkeypatch):
+    monkeypatch.delenv("RS_MAINT_CRASH", raising=False)
+    maint._crash_point("repair", "mid")  # no spec: no raise
+    monkeypatch.setenv("RS_MAINT_CRASH", "repair:mid")
+    maint._crash_point("repair", "claimed")  # wrong stage: no raise
+    maint._crash_point("scrub", "mid")  # wrong kind: no raise
+    with pytest.raises(maint.MaintCrash):
+        maint._crash_point("repair", "mid")
+    monkeypatch.setenv("RS_MAINT_CRASH", "compact")
+    with pytest.raises(maint.MaintCrash):
+        maint._crash_point("compact", "done")  # bare kind: any stage
+
+
+# ----- claim/lease semantics (pure replay) -----------------------------------
+
+
+def _dmg(event, archive, ts, **extra):
+    return {"kind": "rs_damage", "cls": "damage", "event": event,
+            "archive": archive, "ts": ts, **extra}
+
+
+def test_claim_set_expiry_release_semantics():
+    recs = [
+        _dmg("scan", "/a", 100.0, k=3, p=2, generation=0,
+             states={"1": "missing"}),
+        _dmg("claim", "/a", 105.0, owner="w1", lease_s=10.0),
+    ]
+    st = health.replay(recs)
+    assert health.work_queue(st, now=110.0)[0]["claimed_by"] == "w1"
+    # Lease expiry: the claimant is presumed dead, the item frees up.
+    assert health.work_queue(st, now=115.0)[0]["claimed_by"] is None
+    # A foreign release does not clear someone else's claim...
+    st2 = health.replay(recs + [_dmg("release", "/a", 106.0, owner="w2")])
+    assert health.work_queue(st2, now=110.0)[0]["claimed_by"] == "w1"
+    # ...the holder's release does.
+    st3 = health.replay(recs + [_dmg("release", "/a", 106.0, owner="w1")])
+    assert health.work_queue(st3, now=110.0)[0]["claimed_by"] is None
+
+
+def test_claim_cleared_by_completing_repair_and_scan_events():
+    base = [
+        _dmg("scan", "/a", 100.0, k=3, p=2, generation=0,
+             states={"1": "missing"}),
+        _dmg("claim", "/a", 105.0, owner="w1", lease_s=300.0),
+    ]
+    # The completing repair record clears the claim (ledger-driven).
+    st = health.replay(base + [_dmg("repair", "/a", 106.0, chunks=[1])])
+    assert "claim" not in st["archives"]["/a"]
+    # A full scan verdict clears it too (the scrub happy path).
+    st2 = health.replay(base + [_dmg("scan", "/a", 106.0, generation=0,
+                                     states={})])
+    assert "claim" not in st2["archives"]["/a"]
+    # repair_failed deliberately does NOT: lease expiry paces retries.
+    st3 = health.replay(base + [_dmg("repair_failed", "/a", 106.0)])
+    assert health.live_claim(st3["archives"]["/a"], now=110.0) == "w1"
+
+
+# ----- discovery -------------------------------------------------------------
+
+
+def test_discover_orders_repairs_then_update_scrubs_then_stale(
+        tmp_path, ledger):
+    now = 1000.0
+    recs = [
+        # /dmg: outstanding damage -> repair, always first.
+        _dmg("scan", "/dmg", now - 10, k=3, p=2, generation=0,
+             states={"0": "missing"}),
+        # /upd: clean scan, then generation moved past it -> scrub/update.
+        _dmg("scan", "/upd", now - 10, k=3, p=2, generation=0, states={}),
+        _dmg("update", "/upd", now - 5, generation=1),
+        # /old: clean scan aged past the staleness horizon -> scrub/stale.
+        _dmg("scan", "/old", now - 90_000, k=3, p=2, generation=0,
+             states={}),
+    ]
+    with open(ledger, "w") as fp:
+        for r in recs:
+            fp.write(json.dumps(r) + "\n")
+    found = _ctl(ledger).discover(now=now)
+    assert [(j["kind"], j["reason"]) for j in found["jobs"]] == [
+        ("repair", "damage"), ("scrub", "update"), ("scrub", "stale")]
+    assert found["skipped_claimed"] == 0
+    assert found["skipped_failing"] == 0
+
+
+def test_discover_skips_foreign_live_claims_not_own(tmp_path, ledger):
+    now = 1000.0
+    recs = [
+        _dmg("scan", "/theirs", now, k=3, p=2, generation=0,
+             states={"0": "missing", "1": "missing"}),
+        _dmg("scan", "/mine", now, k=3, p=2, generation=0,
+             states={"0": "missing"}),
+        _dmg("claim", "/theirs", now, owner="other", lease_s=300.0),
+        _dmg("claim", "/mine", now, owner="test:maint", lease_s=300.0),
+    ]
+    with open(ledger, "w") as fp:
+        for r in recs:
+            fp.write(json.dumps(r) + "\n")
+    ctl = _ctl(ledger)
+    found = ctl.discover(now=now + 1)
+    # The foreign claim is skipped; our OWN claim is not (restart-stable
+    # owners reclaim their leases immediately).
+    assert [j["target"] for j in found["jobs"]] == ["/mine"]
+    assert found["skipped_claimed"] == 1
+    # Once the foreign lease expires the item frees up.
+    found2 = ctl.discover(now=now + 400)
+    assert [j["target"] for j in found2["jobs"]] == ["/theirs", "/mine"]
+    assert found2["skipped_claimed"] == 0
+
+
+def test_discover_excludes_targets_past_max_attempts(tmp_path, ledger):
+    with open(ledger, "w") as fp:
+        fp.write(json.dumps(_dmg("scan", "/a", 100.0, k=3, p=2,
+                                 generation=0,
+                                 states={"0": "missing"})) + "\n")
+    ctl = _ctl(ledger)
+    ctl._fail_counts[("repair", "/a")] = maint.MAX_ATTEMPTS
+    found = ctl.discover(now=101.0)
+    assert found["jobs"] == [] and found["skipped_failing"] == 1
+
+
+# ----- end-to-end drain convergence ------------------------------------------
+
+
+def test_drain_repairs_damaged_archives_to_empty_queue(tmp_path, ledger):
+    paths = [_mkfile(tmp_path, 30_000, name=f"a{i}.bin", seed=i)
+             for i in range(2)]
+    for p in paths:
+        api.encode_file(p, 3, 2, checksums=True)
+    pristine = {p: _chunks(p, 5) for p in paths}
+    _corrupt(paths[0], 1)
+    os.unlink(chunk_file_name(paths[1], 4))
+    for p in paths:
+        api.scan_file(p)
+    assert len(health.work_queue(health.load(ledger))) == 2
+
+    out = _ctl(ledger).drain()
+    assert out["remaining"] == 0 and out["jobs"] >= 2
+    assert out["skipped_claimed"] == 0 and out["skipped_failing"] == 0
+    assert health.work_queue(health.load(ledger)) == []
+    for p in paths:
+        assert _chunks(p, 5) == pristine[p]
+
+
+def test_drain_compacts_dead_heavy_bucket(tmp_path, ledger):
+    root = str(tmp_path / "store")
+    b = store.open_bucket(root, "bkt", create=True, k=2, p=1,
+                          stripe_bytes=8 * 1024)
+    for i in range(6):
+        b.put(f"k{i}", bytes([i]) * 3000)
+    for i in range(4):
+        b.delete(f"k{i}")
+    assert b.stats()["pending_compactions"] >= 1
+    store.drop_cached()
+
+    ctl = _ctl(ledger, store_roots=[root])
+    found = ctl.discover()
+    compacts = [j for j in found["jobs"] if j["kind"] == "compact"]
+    assert compacts and compacts[0]["bucket"] == "bkt"
+    assert compacts[0]["pending"] >= 1 and compacts[0]["dead_bytes"] > 0
+    out = ctl.drain()
+    assert out["remaining"] == 0
+    assert ctl.jobs["compact"]["ok"] >= 1
+    store.drop_cached()
+    b2 = store.open_bucket(root, "bkt")
+    assert b2.stats()["pending_compactions"] == 0
+    assert b2.get("k4") == bytes([4]) * 3000
+    assert b2.get("k5") == bytes([5]) * 3000
+
+
+def test_crash_mid_repair_then_idempotent_reexecution(
+        tmp_path, ledger, monkeypatch):
+    path = _mkfile(tmp_path, 25_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    pristine = _chunks(path, 5)
+    _corrupt(path, 2)
+    api.scan_file(path)
+
+    monkeypatch.setenv("RS_MAINT_CRASH", "repair:claimed")
+    with pytest.raises(maint.MaintCrash):
+        _ctl(ledger, owner="w1").drain()
+    # The dead claimant left only a ledger claim; same-owner restart
+    # reclaims it immediately and converges.
+    st = health.load(ledger)
+    key = os.path.abspath(path)
+    assert health.live_claim(st["archives"][key]) == "w1"
+    monkeypatch.delenv("RS_MAINT_CRASH")
+    out = _ctl(ledger, owner="w1").drain()
+    assert out["remaining"] == 0
+    assert _chunks(path, 5) == pristine
+    assert health.work_queue(health.load(ledger)) == []
+
+
+def test_two_owners_never_double_repair(tmp_path, ledger):
+    path = _mkfile(tmp_path, 20_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 0)
+    api.scan_file(path)
+    health.record_claim(path, "other-host:maint", lease_s=300.0,
+                        ledger_path=ledger)
+
+    ctl = _ctl(ledger, owner="me:maint")
+    found = ctl.discover()
+    assert found["jobs"] == [] and found["skipped_claimed"] == 1
+    # A drain over only-blocked work terminates without touching it.
+    out = ctl.drain()
+    assert out["jobs"] == 0 and out["skipped_claimed"] == 1
+    assert ctl.jobs == {}
+
+
+def test_unrecoverable_target_backs_off_after_max_attempts(
+        tmp_path, ledger):
+    path = _mkfile(tmp_path, 20_000)
+    api.encode_file(path, 3, 1, checksums=True)
+    for idx in (0, 2):  # two losses, p=1: unrecoverable
+        os.unlink(chunk_file_name(path, idx))
+    api.scan_file(path)
+
+    ctl = _ctl(ledger)
+    out = ctl.drain()
+    # Retried MAX_ATTEMPTS times, then excluded so the drain terminates.
+    assert ctl.jobs["repair"]["error"] == maint.MAX_ATTEMPTS
+    assert out["remaining"] == 0 and out["skipped_failing"] >= 1
+    assert "error" in (ctl.last_error or "").lower() or ctl.last_error
+
+
+def test_step_pauses_on_foreground_burn_and_resumes(tmp_path, ledger):
+    path = _mkfile(tmp_path, 20_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 1)
+    api.scan_file(path)
+
+    burn = {"v": 2.0}
+    ctl = _ctl(ledger, slo_report=lambda: _report(burn["v"]),
+               burn_pause=1.0, burn_resume=0.5)
+    out = ctl.step()
+    assert out == {"ran": 0, "paused": True, "deferred": False,
+                   "pending": None}
+    assert len(health.work_queue(health.load(ledger))) == 1  # untouched
+    burn["v"] = 0.1
+    out2 = ctl.step()
+    assert out2["ran"] >= 1 and out2["paused"] is False
+    st = ctl.stats()
+    assert st["pause_events"] == 1 and st["resume_events"] == 1
+    assert health.work_queue(health.load(ledger)) == []
+
+
+def test_stats_schema_and_queue_depths(tmp_path, ledger):
+    path = _mkfile(tmp_path, 15_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 0)
+    api.scan_file(path)
+    ctl = _ctl(ledger)
+    st = ctl.stats(include_queue=True)
+    assert {"owner", "tenant", "running", "paused", "pause_events",
+            "resume_events", "last_burn", "burn_pause", "burn_resume",
+            "bytes_per_s", "bytes_total", "lease_s", "interval_s",
+            "passes", "loop_errors", "jobs", "jobs_total", "last_jobs",
+            "governor_events", "queue"} <= set(st)
+    assert st["running"] is False and st["jobs_total"] == 0
+    assert st["queue"] == {"repair": 1, "scrub": 0, "compact": 0,
+                           "skipped_claimed": 0, "skipped_failing": 0}
+
+
+# ----- rs maint CLI ----------------------------------------------------------
+
+
+def test_cli_maint_requires_sources(monkeypatch, capsys):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    assert cli.main(["maint"]) == 2
+    assert "no work sources" in capsys.readouterr().err
+
+
+def test_cli_maint_dry_run_then_drain(tmp_path, ledger, capsys):
+    path = _mkfile(tmp_path, 25_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 1)
+    api.scan_file(path)
+    capsys.readouterr()
+    # Dry run: lists the queue, touches nothing.
+    assert cli.main(["maint", "--json"]) == 0
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["kind"] == "rs_maint_queue"
+    assert [j["kind"] for j in doc["jobs"]] == ["repair"]
+    assert len(health.work_queue(health.load(ledger))) == 1
+    # Drain: converges and exits 0.
+    assert cli.main(["maint", "--drain", "--json"]) == 0
+    doc2 = json.loads(capsys.readouterr().out)
+    assert doc2["kind"] == "rs_maint_drain" and doc2["remaining"] == 0
+    assert health.work_queue(health.load(ledger)) == []
+    # Human table mode renders too.
+    assert cli.main(["maint"]) == 0
+    assert "maint queue: 0 job(s)" in capsys.readouterr().out
+
+
+def test_cli_maint_drain_max_jobs_exits_nonzero_on_remaining(
+        tmp_path, ledger, capsys):
+    for i in range(2):
+        p = _mkfile(tmp_path, 15_000, name=f"m{i}.bin", seed=i)
+        api.encode_file(p, 3, 2, checksums=True)
+        _corrupt(p, 0)
+        api.scan_file(p)
+    capsys.readouterr()
+    assert cli.main(["maint", "--drain", "--max-jobs", "1",
+                     "--json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert doc["jobs"] == 1 and doc["remaining"] >= 1
+
+
+def test_cli_maint_watch_count(tmp_path, ledger, capsys):
+    path = _mkfile(tmp_path, 15_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 0)
+    api.scan_file(path)
+    capsys.readouterr()
+    assert cli.main(["maint", "--watch", "0.05", "--count", "2",
+                     "--json"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert len(lines) == 2
+    rows = [json.loads(ln) for ln in lines]
+    assert rows[0]["kind"] == "rs_maint_pass" and rows[0]["ran"] == 1
+    assert rows[1]["ran"] == 0  # converged on the first pass
+
+
+# ----- serve daemon ----------------------------------------------------------
+
+
+def test_daemon_maint_disabled_by_default(tmp_path, monkeypatch):
+    monkeypatch.delenv("RS_MAINT", raising=False)
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    d = ServeDaemon(str(tmp_path / "root"), port=0, batch_ms=2)
+    d.start()
+    try:
+        assert d.maint is None
+        assert not [t for t in threading.enumerate()
+                    if t.name == "rs-maint"]
+        st, rep = _get_json(d.port, "/maint")
+        assert st == 200
+        assert rep["kind"] == "rs_maint" and rep["enabled"] is False
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+
+
+def test_daemon_maint_repairs_and_get_maint_reports(
+        tmp_path, ledger, monkeypatch):
+    monkeypatch.setenv("RS_MAINT_INTERVAL_S", "0.05")
+    root = str(tmp_path / "root")
+    os.makedirs(os.path.join(root, "alpha"))
+    path = _mkfile(tmp_path / "root" / "alpha", 25_000, name="arc.bin")
+    api.encode_file(path, 3, 2, checksums=True)
+    pristine = _chunks(path, 5)
+    _corrupt(path, 2)
+    api.scan_file(path)
+
+    d = ServeDaemon(root, port=0, batch_ms=2, maint=True)
+    d.start()
+    try:
+        assert d.maint is not None
+        deadline = time.monotonic() + 30
+        rep = None
+        while time.monotonic() < deadline:
+            st, rep = _get_json(d.port, "/maint")
+            assert st == 200 and rep["enabled"] is True
+            q = rep.get("queue") or {}
+            if (q.get("repair") == 0 and q.get("scrub") == 0
+                    and rep["jobs_total"] >= 1):
+                break
+            time.sleep(0.05)
+        assert rep["queue"]["repair"] == 0 and rep["queue"]["scrub"] == 0
+        assert rep["running"] is True and rep["jobs_total"] >= 1
+        assert rep["jobs"]["repair"]["ok"] >= 1
+        assert rep["owner"].endswith(f":serve:{os.path.abspath(root)}")
+        assert [t for t in threading.enumerate() if t.name == "rs-maint"]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{d.port}/metrics", timeout=10) as resp:
+            text = resp.read().decode()
+        assert 'rs_maint_jobs_total{kind="repair",outcome="ok"}' in text
+    finally:
+        d.close(drain=True, timeout=60)
+        metrics.force_enable(False)
+        metrics.REGISTRY.reset()
+    assert _chunks(path, 5) == pristine
+    assert not [t for t in threading.enumerate() if t.name == "rs-maint"]
+
+
+# ----- doctor ----------------------------------------------------------------
+
+
+def test_doctor_maint_section(tmp_path, ledger, monkeypatch):
+    monkeypatch.setenv("RS_MAINT", "1")
+    path = _mkfile(tmp_path, 20_000)
+    api.encode_file(path, 3, 2, checksums=True)
+    _corrupt(path, 0)
+    api.scan_file(path)
+    report = doctor.collect()
+    assert "maint" in report and set(doctor.SECTIONS) <= set(report)
+    m = report["maint"]
+    assert m["enabled"] is True and m["tenant"] == "maint"
+    assert m["repairs"] == 1 and m["scrubs"] == 0 and m["claimed"] == 0
+    text = doctor.render(report)
+    assert "maint:" in text and "1 repair(s)" in text
+
+
+def test_doctor_maint_section_without_ledger(monkeypatch):
+    monkeypatch.delenv("RS_RUNLOG", raising=False)
+    monkeypatch.delenv("RS_MAINT", raising=False)
+    report = doctor.collect()
+    assert report["maint"]["enabled"] is False
+    assert "error" in report["maint"]
+
+
+# ----- chaos plan ------------------------------------------------------------
+
+
+def test_chaos_maint_plan_deterministic_and_convergent():
+    from gpu_rscode_tpu.resilience import chaos
+
+    cfgs = [chaos.plan_maint_iteration(11, i) for i in range(6)]
+    assert all(c["mode"] == "maint" for c in cfgs)
+    assert cfgs == [chaos.plan_maint_iteration(11, i) for i in range(6)]
+    for c in cfgs:
+        # Damage never exceeds parity: every schedule must converge.
+        assert 1 <= len(c["events"]) <= c["p"]
+        assert c["crash"] in (None, "repair:claimed", "repair:mid",
+                              "scrub:claimed", "compact:claimed",
+                              "compact:done")
+        assert c["puts"] and len(c["deletes"]) >= 1
